@@ -1,0 +1,22 @@
+"""gome_tpu — a TPU-native limit-order-book matching framework.
+
+A ground-up rebuild of the capabilities of lxalano/gome (a Go + gRPC +
+RabbitMQ + Redis matching-engine microservice; see SURVEY.md) designed for
+TPU hardware: each symbol's order book is a fixed-shape HBM-resident array
+structure, price-time-priority matching is a vectorized JAX/Pallas step
+function `vmap`'d across thousands of independent symbols and sharded across
+chips with `jax.sharding`.
+
+Layout:
+  gome_tpu.types    — domain types (Side, Action, Order, MatchResult)
+  gome_tpu.fixed    — fixed-point scaling (reference: gomengine/engine/ordernode.go:76-87)
+  gome_tpu.oracle   — pure-Python executable model of the reference semantics
+  gome_tpu.engine   — JAX book state + match/cancel step functions
+  gome_tpu.ops      — Pallas TPU kernels for the hot path
+  gome_tpu.parallel — device mesh, shardings, symbol routing
+  gome_tpu.bridge   — gRPC/socket front door + micro-batcher (reference: gomengine/main.go)
+  gome_tpu.persist  — snapshot/restore + replay recovery (reference: Redis-is-the-book, SURVEY §5.4)
+  gome_tpu.utils    — config, logging, metrics
+"""
+
+__version__ = "0.1.0"

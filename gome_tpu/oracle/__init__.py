@@ -1,0 +1,3 @@
+from .book import OracleEngine, SymbolBook, RestingOrder
+
+__all__ = ["OracleEngine", "SymbolBook", "RestingOrder"]

@@ -1,0 +1,16 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU platform BEFORE jax is imported so
+multi-chip sharding tests run without TPU hardware, and enables x64 so
+int64 tick/lot arithmetic is exact (SURVEY §2.2).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

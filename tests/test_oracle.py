@@ -1,0 +1,290 @@
+"""Tests for the pure-Python semantic oracle.
+
+These encode the reference's observable behavior (gomengine/engine/engine.go
+and friends — citations inline) and serve as the spec for the JAX engine.
+"""
+
+from gome_tpu.fixed import scale
+from gome_tpu.oracle import OracleEngine
+from gome_tpu.types import Action, MatchResult, Order, OrderType, Side
+from gome_tpu.utils.streams import doorder_stream, mixed_stream
+
+
+def o(
+    oid,
+    side,
+    price,
+    volume,
+    uuid="u1",
+    symbol="btc2usdt",
+    action=Action.ADD,
+    order_type=OrderType.LIMIT,
+):
+    return Order(
+        uuid=uuid,
+        oid=str(oid),
+        symbol=symbol,
+        side=side,
+        price=scale(price),
+        volume=scale(volume),
+        action=action,
+        order_type=order_type,
+    )
+
+
+def test_rest_then_full_cross():
+    """A buy crossing one resting ask fills at the maker's price."""
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.00, 0.5))
+    events = e.process(o(2, Side.BUY, 1.10, 0.5))
+    assert len(events) == 1
+    ev = events[0]
+    assert ev.match_volume == scale(0.5)
+    assert ev.match_node.oid == "1"
+    assert ev.match_node.price == scale(1.00)  # fill at maker level
+    assert ev.match_node.volume == scale(0.5)  # full fill: pre-fill volume
+    assert ev.node.oid == "2"
+    assert ev.node.volume == 0  # taker exhausted
+    assert ev.node.price == scale(1.10)  # taker keeps its own limit price
+    book = e.book("btc2usdt")
+    assert book.depth(Side.SALE) == []
+    assert book.depth(Side.BUY) == []
+
+
+def test_partial_maker_fill_event_has_remaining_volume():
+    """engine.go:176-194 — partial fill: MatchNode.Volume = maker remaining."""
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.00, 1.0))
+    events = e.process(o(2, Side.BUY, 1.00, 0.3))
+    assert len(events) == 1
+    ev = events[0]
+    assert ev.match_volume == scale(0.3)
+    assert ev.match_node.volume == scale(0.7)  # post-fill remaining
+    assert ev.node.volume == 0
+    assert e.book("btc2usdt").depth(Side.SALE) == [(scale(1.00), scale(0.7))]
+
+
+def test_taker_remainder_rests_at_own_price():
+    """engine.go:69-83 — unfilled remainder rests at the taker's limit."""
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.00, 0.3))
+    events = e.process(o(2, Side.BUY, 1.05, 1.0))
+    assert len(events) == 1
+    assert events[0].match_volume == scale(0.3)
+    book = e.book("btc2usdt")
+    assert book.depth(Side.BUY) == [(scale(1.05), scale(0.7))]
+    assert book.depth(Side.SALE) == []
+
+
+def test_price_priority_best_first():
+    """BUY taker consumes asks lowest-price-first (nodepool.go:101-103)."""
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.02, 0.2))
+    e.process(o(2, Side.SALE, 1.00, 0.2))
+    e.process(o(3, Side.SALE, 1.01, 0.2))
+    events = e.process(o(4, Side.BUY, 1.02, 0.6))
+    assert [ev.match_node.oid for ev in events] == ["2", "3", "1"]
+    assert [ev.match_node.price for ev in events] == [
+        scale(1.00),
+        scale(1.01),
+        scale(1.02),
+    ]
+
+
+def test_sale_taker_consumes_bids_highest_first():
+    """SALE taker consumes bids highest-price-first (nodepool.go:90-92)."""
+    e = OracleEngine()
+    e.process(o(1, Side.BUY, 0.98, 0.2))
+    e.process(o(2, Side.BUY, 1.00, 0.2))
+    e.process(o(3, Side.BUY, 0.99, 0.2))
+    events = e.process(o(4, Side.SALE, 0.98, 0.6))
+    assert [ev.match_node.oid for ev in events] == ["2", "3", "1"]
+
+
+def test_time_priority_fifo_within_level():
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.00, 0.2, uuid="a"))
+    e.process(o(2, Side.SALE, 1.00, 0.2, uuid="b"))
+    events = e.process(o(3, Side.BUY, 1.00, 0.3))
+    assert [ev.match_node.oid for ev in events] == ["1", "2"]
+    assert events[0].match_volume == scale(0.2)  # full first maker
+    assert events[1].match_volume == scale(0.1)  # partial second
+    assert events[1].match_node.volume == scale(0.1)  # remaining
+
+
+def test_non_crossing_price_does_not_match():
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.01, 0.5))
+    events = e.process(o(2, Side.BUY, 1.00, 0.5))
+    assert events == []
+    book = e.book("btc2usdt")
+    assert book.depth(Side.BUY) == [(scale(1.00), scale(0.5))]
+    assert book.depth(Side.SALE) == [(scale(1.01), scale(0.5))]
+
+
+def test_no_self_trade_prevention():
+    """SURVEY §2.3.4 — same uuid happily self-matches."""
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.00, 0.5, uuid="x"))
+    events = e.process(o(2, Side.BUY, 1.00, 0.5, uuid="x"))
+    assert len(events) == 1 and events[0].match_volume == scale(0.5)
+
+
+def test_cancel_emits_zero_volume_event_with_remaining():
+    """engine.go:100,109-113 — cancel event carries remaining volume."""
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.00, 1.0))
+    e.process(o(2, Side.BUY, 1.00, 0.4))  # partial fill -> 0.6 remains
+    events = e.process(
+        o(1, Side.SALE, 1.00, 1.0, action=Action.DEL)
+    )
+    assert len(events) == 1
+    ev = events[0]
+    assert ev.is_cancel and ev.match_volume == 0
+    assert ev.node.volume == scale(0.6)
+    assert ev.node == ev.match_node
+    assert e.book("btc2usdt").depth(Side.SALE) == []
+
+
+def test_cancel_requires_exact_price():
+    """SURVEY §2.3.2 — wrong price ⇒ lookup miss, no event."""
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.00, 1.0))
+    events = e.process(o(1, Side.SALE, 1.01, 1.0, action=Action.DEL))
+    assert events == []
+    assert e.book("btc2usdt").depth(Side.SALE) == [(scale(1.00), scale(1.0))]
+
+
+def test_cancel_of_filled_order_is_noop():
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.00, 0.5))
+    e.process(o(2, Side.BUY, 1.00, 0.5))
+    events = e.process(o(1, Side.SALE, 1.00, 0.5, action=Action.DEL))
+    assert events == []
+
+
+def test_cancel_add_in_fifo_order_cancels_rested_order():
+    """ADD then DEL through the FIFO queue (both ride "doOrder",
+    main.go:48,60): the ADD rests, the DEL cancels it — one cancel event."""
+    e = OracleEngine()
+    e.submit(o(1, Side.SALE, 1.00, 1.0))
+    e.submit(o(1, Side.SALE, 1.00, 1.0, action=Action.DEL))
+    events = e.drain()
+    assert len(events) == 1 and events[0].is_cancel
+    assert e.book("btc2usdt").depth(Side.SALE) == []
+
+
+def test_cancel_overtaking_add_drops_queued_add():
+    """SURVEY §2.3.3 — if the DEL is consumed before the ADD (publish-time
+    reordering between concurrent gRPC handlers), the DEL clears the
+    pre-pool marker and the ADD is dropped at consume time
+    (engine.go:58-62,88-90)."""
+    e = OracleEngine()
+    add = o(1, Side.SALE, 1.00, 1.0)
+    e.submit(add)  # marks pre-pool, queues ADD
+    e.do_order(o(1, Side.SALE, 1.00, 1.0, action=Action.DEL))  # DEL first
+    events = e.drain()  # now the queued ADD is consumed
+    assert events == []  # ADD dropped; DEL found nothing resting
+    assert e.book("btc2usdt").depth(Side.SALE) == []
+    assert e.stats.dropped_no_prepool == 1
+
+
+def test_multi_level_depth_walk():
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.00, 0.2))
+    e.process(o(2, Side.SALE, 1.01, 0.2))
+    e.process(o(3, Side.SALE, 1.02, 0.2))
+    events = e.process(o(4, Side.BUY, 1.05, 0.5))
+    assert [ev.match_volume for ev in events] == [
+        scale(0.2),
+        scale(0.2),
+        scale(0.1),
+    ]
+    # Taker exhausted mid-walk; level 1.02 keeps 0.1.
+    assert e.book("btc2usdt").depth(Side.SALE) == [(scale(1.02), scale(0.1))]
+    # Taker remaining decreases across its own event stream.
+    assert [ev.node.volume for ev in events] == [scale(0.3), scale(0.1), 0]
+
+
+def test_market_order_crosses_everything_and_never_rests():
+    """Extension (BASELINE config 5): market buy walks all asks; remainder
+    is dropped."""
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.00, 0.2))
+    e.process(o(2, Side.SALE, 5.00, 0.2))
+    events = e.process(
+        o(3, Side.BUY, 0.0, 1.0, order_type=OrderType.MARKET)
+    )
+    assert [ev.match_node.price for ev in events] == [scale(1.00), scale(5.00)]
+    book = e.book("btc2usdt")
+    assert book.depth(Side.SALE) == []
+    assert book.depth(Side.BUY) == []  # remainder did not rest
+
+
+def test_symbols_are_isolated():
+    """SURVEY §2.1 — symbols share nothing."""
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.00, 0.5, symbol="aaa"))
+    events = e.process(o(2, Side.BUY, 1.00, 0.5, symbol="bbb"))
+    assert events == []
+    assert e.book("aaa").depth(Side.SALE) == [(scale(1.00), scale(0.5))]
+    assert e.book("bbb").depth(Side.BUY) == [(scale(1.00), scale(0.5))]
+
+
+def _invariants(e: OracleEngine):
+    for book in e.books.values():
+        bids = book.depth(Side.BUY)
+        asks = book.depth(Side.SALE)
+        if bids and asks:
+            assert bids[0][0] < asks[0][0], "book crossed"
+        for _, vol in bids + asks:
+            assert vol > 0
+
+
+def test_doorder_stream_volume_conservation_and_invariants():
+    """Replay the reference's own load driver shape (doorder.go:37-59) and
+    check conservation + non-crossing after every step."""
+    e = OracleEngine()
+    total_in = 0
+    matched = 0
+    for order in doorder_stream(n=500, seed=7):
+        total_in += order.volume
+        events = e.process(order)
+        for ev in events:
+            assert ev.match_volume > 0
+            matched += 2 * ev.match_volume
+        _invariants(e)
+    book = e.book("eth2usdt")
+    resting = sum(v for _, v in book.depth(Side.BUY)) + sum(
+        v for _, v in book.depth(Side.SALE)
+    )
+    assert total_in == matched + resting
+
+
+def test_mixed_stream_with_cancels_conservation():
+    e = OracleEngine()
+    total_in = matched = cancelled = 0
+    for order in mixed_stream(n=1000, seed=3, cancel_prob=0.25):
+        if order.action is Action.ADD:
+            total_in += order.volume
+        events = e.process(order)
+        for ev in events:
+            if ev.is_cancel:
+                cancelled += ev.node.volume
+            else:
+                matched += 2 * ev.match_volume
+        _invariants(e)
+    book = e.book("eth2usdt")
+    resting = sum(v for _, v in book.depth(Side.BUY)) + sum(
+        v for _, v in book.depth(Side.SALE)
+    )
+    assert total_in == matched + cancelled + resting
+
+
+def test_event_snapshot_symbol_and_sides():
+    e = OracleEngine()
+    e.process(o(1, Side.SALE, 1.00, 0.5, uuid="maker"))
+    ev = e.process(o(2, Side.BUY, 1.00, 0.5, uuid="taker"))[0]
+    assert ev.node.side is Side.BUY and ev.match_node.side is Side.SALE
+    assert ev.node.uuid == "taker" and ev.match_node.uuid == "maker"
+    assert ev.node.symbol == ev.match_node.symbol == "btc2usdt"
