@@ -1,0 +1,11 @@
+from .book import BookConfig, BookState, DeviceOp, StepOutput, init_book
+from .step import step
+
+__all__ = [
+    "BookConfig",
+    "BookState",
+    "DeviceOp",
+    "StepOutput",
+    "init_book",
+    "step",
+]

@@ -1,0 +1,168 @@
+"""Fixed-shape, array-resident order-book state for one symbol.
+
+This is the TPU re-expression of the reference's Redis schema (SURVEY §2.1):
+the S:BUY/S:SALE price zsets, the S:depth volume hash, and the S:link:P
+hash-encoded FIFO linked lists (gomengine/engine/nodepool.go,
+gomengine/engine/nodelink.go) all collapse into five [2, CAP] integer arrays
+kept sorted in *priority order* per side:
+
+  * side 0 (BUY bids):  descending price, FIFO (ascending seq) within price
+  * side 1 (SALE asks): ascending price,  FIFO (ascending seq) within price
+
+Active orders occupy a contiguous prefix of length ``count[side]``; slot 0 is
+always the best-priority resting order. Keeping the invariant "sorted,
+prefix-packed" turns the reference's O(levels x orders) pointer-chasing match
+loop (engine.go:118-198) into branch-free vector ops: a crossing mask is a
+prefix, fill quantities are one exclusive cumsum, removals are a left-shift
+gather, and inserts are a right-shift gather — no `lax.while_loop`, no
+data-dependent shapes, fully `vmap`-able across thousands of symbols.
+
+Prices and volumes are scaled integer ticks/lots (see gome_tpu.fixed);
+oid/uid are integer handles interned by the host bridge (the string ids of
+api/order.proto:11-12 never reach the device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+BUY = 0
+SALE = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class BookConfig:
+    """Static (compile-time) book geometry.
+
+    cap      — max resting orders per side per symbol. The reference's book
+               is unbounded (Redis); fixed capacity is the §5.7 "windowed
+               ladder" trade: overflow is reported and spilled to the host
+               slow path, never silently dropped.
+    max_fills — fill records emitted per op (K). An op crossing more than K
+               resting orders still mutates the book exactly; records beyond
+               K are counted in `fill_overflow` and recovered by the host
+               slow path (SURVEY §7 hard part (c)).
+    dtype    — lot/price dtype. int64 (default) matches the reference's
+               exact-integer envelope at accuracy=8 (SURVEY §2.2); int32 is
+               available when tick/lot ranges allow, halving HBM traffic.
+    """
+
+    cap: int = 256
+    max_fills: int = 16
+    dtype: jnp.dtype = jnp.int64
+
+    @property
+    def seq_dtype(self):
+        return jnp.int32
+
+
+class BookState(NamedTuple):
+    """One symbol's book. All arrays [2, cap] except count [2] and the
+    per-symbol arrival counter next_seq [] (the time-priority stamp that the
+    reference keeps implicitly as linked-list position, nodelink.go:53-64)."""
+
+    price: jax.Array
+    lots: jax.Array  # remaining lots; 0 <=> slot empty (beyond count)
+    seq: jax.Array
+    oid: jax.Array
+    uid: jax.Array
+    count: jax.Array
+    next_seq: jax.Array
+
+
+class DeviceOp(NamedTuple):
+    """One operation in device form (the OrderNode fields that matter on
+    device; ordernode.go:9-36 minus the Redis key plumbing). Scalars here;
+    batched versions carry leading axes."""
+
+    action: jax.Array  # i32: 0=NOP, 1=ADD, 2=DEL (gomengine/main.go:14-18)
+    side: jax.Array  # i32: 0=BUY, 1=SALE (api/order.proto:4-7)
+    is_market: jax.Array  # i32 bool: MARKET extension (BASELINE config 5)
+    price: jax.Array  # dtype ticks
+    volume: jax.Array  # dtype lots
+    oid: jax.Array  # dtype interned order id
+    uid: jax.Array  # dtype interned user id
+
+
+class StepOutput(NamedTuple):
+    """Fixed-shape per-op result — everything the host needs to reconstruct
+    the reference's MatchResult event stream (SURVEY §3.4) for this op.
+
+    Fill j (j < min(n_fills, K)) reconstructs to one fill event:
+      maker volume field = maker_prefill[j] if maker_remaining[j]==0 (full
+      fill, engine.go:154,171) else maker_remaining[j] (partial,
+      engine.go:190); taker volume field = taker_after[j].
+    """
+
+    fill_price: jax.Array  # [K] maker level price (the fill price)
+    fill_qty: jax.Array  # [K] traded lots
+    maker_oid: jax.Array  # [K]
+    maker_uid: jax.Array  # [K]
+    maker_prefill: jax.Array  # [K] maker lots before this fill
+    maker_remaining: jax.Array  # [K] maker lots after this fill
+    taker_after: jax.Array  # [K] taker remaining after fill j
+    n_fills: jax.Array  # i32 total fills (may exceed K)
+    fill_overflow: jax.Array  # i32 fills not captured in records
+    taker_remaining: jax.Array  # taker lots left after matching
+    rested: jax.Array  # i32 bool: remainder rested in the book
+    book_overflow: jax.Array  # i32 bool: rest dropped, side full
+    cancel_found: jax.Array  # i32 bool: DEL matched a resting order
+    cancel_volume: jax.Array  # lots remaining at cancel (engine.go:100)
+
+
+def init_book(config: BookConfig) -> BookState:
+    shape = (2, config.cap)
+    z = jnp.zeros(shape, config.dtype)
+    return BookState(
+        price=z,
+        lots=z,
+        seq=jnp.zeros(shape, config.seq_dtype),
+        oid=z,
+        uid=z,
+        count=jnp.zeros((2,), jnp.int32),
+        next_seq=jnp.zeros((), config.seq_dtype),
+    )
+
+
+def init_books(config: BookConfig, n_symbols: int) -> BookState:
+    """A stacked [n_symbols, ...] book pytree (leading symbol axis — the
+    vmap/sharding axis; SURVEY §2.1 "symbol isolation")."""
+    one = init_book(config)
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (n_symbols,) + x.shape), one
+    )
+
+
+def book_depth(book: BookState, side: int, max_levels: int):
+    """Aggregate [price, volume] depth view, best-first — the observable
+    equivalent of the reference's S:BUY/S:SALE zset + S:depth hash
+    (nodepool.go:61-83). Returns (prices[max_levels], volumes[max_levels],
+    n_levels); unused slots are zero.
+
+    Segment-reduces the sorted per-order arrays: a new level starts wherever
+    the price differs from the previous active slot.
+    """
+    cap = book.price.shape[-1]
+    idx = jnp.arange(cap)
+    active = idx < book.count[side]
+    price = book.price[side]
+    lots = jnp.where(active, book.lots[side], 0)
+    is_new = active & ((idx == 0) | (price != jnp.roll(price, 1)))
+    level_id = jnp.cumsum(is_new) - 1  # per-slot level index
+    level_id = jnp.where(active, level_id, max_levels)
+    volumes = jax.ops.segment_sum(lots, level_id, num_segments=max_levels + 1)[
+        :max_levels
+    ]
+    m = min(max_levels, cap)  # there can be at most `cap` distinct levels
+    first_slot = jnp.where(is_new, idx, cap)
+    order = jnp.argsort(first_slot)[:m]
+    prices = jnp.where(jnp.arange(m) < jnp.sum(is_new), price[order], 0)
+    prices = jnp.pad(prices, (0, max_levels - m))
+    # n is clipped to max_levels: callers iterate the returned arrays; a book
+    # with more distinct levels than max_levels is truncated (best-first).
+    n = jnp.minimum(jnp.sum(is_new), max_levels).astype(jnp.int32)
+    return prices, volumes, n

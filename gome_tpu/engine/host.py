@@ -1,0 +1,119 @@
+"""Host-side plumbing between string-keyed Orders and the integer device ops,
+plus reconstruction of the reference MatchResult event stream from
+StepOutputs.
+
+The reference's string ids (api/order.proto:11-12) and Redis key-name
+machinery (ordernode.go:89-117) never reach the device: the host interns
+strings to dense integer handles, ships fixed-shape integer ops, and decodes
+fixed-shape fill records back into events byte-equivalent (field-for-field)
+with engine.go:24-28's MatchResult.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..types import Action, MatchResult, Order, OrderType, snapshot_of
+from .book import BookConfig, DeviceOp, StepOutput
+
+
+class Interner:
+    """Bidirectional string <-> dense int id table. Id 0 is reserved for
+    "none" (empty slots in device arrays)."""
+
+    def __init__(self) -> None:
+        self._to_id: dict[str, int] = {}
+        self._to_str: list[str] = [""]
+
+    def intern(self, s: str) -> int:
+        i = self._to_id.get(s)
+        if i is None:
+            i = len(self._to_str)
+            self._to_id[s] = i
+            self._to_str.append(s)
+        return i
+
+    def lookup(self, i: int) -> str:
+        return self._to_str[i]
+
+    def __len__(self) -> int:
+        return len(self._to_str)
+
+
+@dataclasses.dataclass
+class OpContext:
+    """What the host must remember about a dispatched op to decode its
+    StepOutput into events (the device echoes none of this)."""
+
+    order: Order
+
+
+def encode_op(order: Order, oids: Interner, uids: Interner) -> DeviceOp:
+    """Order -> scalar DeviceOp (numpy scalars; cheap to batch later)."""
+    if order.action is Action.ADD and order.volume <= 0:
+        raise ValueError(
+            f"volume must be positive, got {order.volume} (oid={order.oid}); "
+            "volume<=0 is out of contract (see gome_tpu.oracle docstring)"
+        )
+    return DeviceOp(
+        action=np.int32(int(order.action)),  # Action values == device codes
+        side=np.int32(int(order.side)),
+        is_market=np.int32(order.order_type is OrderType.MARKET),
+        price=np.int64(order.price),
+        volume=np.int64(order.volume),
+        oid=np.int64(oids.intern(order.oid)),
+        uid=np.int64(uids.intern(order.uuid)),
+    )
+
+
+def decode_events(
+    ctx: OpContext,
+    out: StepOutput,
+    config: BookConfig,
+    oids: Interner,
+    uids: Interner,
+) -> list[MatchResult]:
+    """StepOutput -> the MatchResult events this op produced, in the
+    reference's emission order (best level first, FIFO within level —
+    exactly the device's fill-record order)."""
+    order = ctx.order
+    events: list[MatchResult] = []
+    if order.action is Action.ADD:
+        if int(out.book_overflow):
+            # The device dropped the resting remainder because the side was
+            # full (BookConfig.cap). Loud until the host spill path exists —
+            # overflow must never be silent (book.py BookConfig contract).
+            raise OverflowError(
+                f"op {order.oid}: resting insert dropped, side full "
+                f"(cap={config.cap}); host spill path required"
+            )
+        n = int(out.n_fills)
+        if n > config.max_fills:
+            raise OverflowError(
+                f"op {order.oid} produced {n} fills > max_fills="
+                f"{config.max_fills}; host slow path required"
+            )
+        for j in range(n):
+            qty = int(out.fill_qty[j])
+            remaining = int(out.maker_remaining[j])
+            maker_volume = int(out.maker_prefill[j]) if remaining == 0 else remaining
+            maker = snapshot_of(
+                Order(
+                    uuid=uids.lookup(int(out.maker_uid[j])),
+                    oid=oids.lookup(int(out.maker_oid[j])),
+                    symbol=order.symbol,
+                    side=order.side.opposite,
+                    price=int(out.fill_price[j]),
+                    volume=maker_volume,
+                )
+            )
+            taker = snapshot_of(order, int(out.taker_after[j]))
+            events.append(
+                MatchResult(node=taker, match_node=maker, match_volume=qty)
+            )
+    elif order.action is Action.DEL and int(out.cancel_found):
+        snap = snapshot_of(order, int(out.cancel_volume))
+        events.append(MatchResult(node=snap, match_node=snap, match_volume=0))
+    return events

@@ -1,0 +1,261 @@
+"""The single-op book transition: ADD (match + rest), DEL (cancel), NOP.
+
+This one function replaces the reference's entire consumer hot path —
+SetOrder/Match/MatchOrder/DeleteOrder (gomengine/engine/engine.go:56-198) and
+all the Redis round trips behind them (SURVEY §3.2: ~6 + 2·levels + 4·fills
+RTTs per order) — with a fixed number of O(cap) vector operations:
+
+  match   = prefix mask + one exclusive cumsum + clip      (engine.go:118-198)
+  removal = left-shift gather of the filled prefix         (nodelink.go:124-166)
+  rest    = right-shift gather insert at the priority slot (nodepool.go:31-46)
+  cancel  = masked locate + left-shift gather              (engine.go:87-116)
+
+Everything is branch-free (ADD and DEL paths are both computed and selected
+by mask) so the function vmaps cleanly across the symbol axis and compiles
+to a static XLA graph — no data-dependent control flow, per the TPU design
+rules. Scalar semantics are checked against the Python oracle in
+tests/test_engine_step.py; the oracle is the spec (SURVEY §7 step 1).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..types import Action
+from .book import BUY, BookConfig, BookState, DeviceOp, StepOutput
+
+# Device-side action codes are the types.Action values (single source of
+# truth; they mirror gomengine/main.go:14-18's iota consts).
+ACTION_NOP = int(Action.NOP)
+ACTION_ADD = int(Action.ADD)
+ACTION_DEL = int(Action.DEL)
+
+
+class _Side(NamedTuple):
+    """One side's slot arrays (a row of each BookState array)."""
+
+    price: jax.Array
+    lots: jax.Array
+    seq: jax.Array
+    oid: jax.Array
+    uid: jax.Array
+
+    def shift_left(self, by, cap: int) -> "_Side":
+        """Drop `by` leading slots (removals always form a prefix after a
+        match; an arbitrary slot for cancels is handled by _remove)."""
+        idx = jnp.arange(cap)
+        src = jnp.clip(idx + by, 0, cap - 1)
+        keep = idx + by < cap
+
+        def g(a):
+            return jnp.where(keep, a[src], jnp.zeros_like(a))
+
+        return _Side(*(g(a) for a in self))
+
+
+def _side_of(book: BookState, s) -> _Side:
+    return _Side(
+        price=book.price[s],
+        lots=book.lots[s],
+        seq=book.seq[s],
+        oid=book.oid[s],
+        uid=book.uid[s],
+    )
+
+
+def _match(
+    config: BookConfig, opp: _Side, opp_count, side, price, volume, is_market
+):
+    """Fill the crossing prefix of the opposing side.
+
+    Crossing rule (nodepool.go:86-115): BUY taker hits asks with price <=
+    limit; SALE taker hits bids with price >= limit; MARKET (extension)
+    hits every active order. Because the side is priority-sorted, crossing
+    slots are a contiguous prefix, so "walk levels best-first, FIFO within
+    level" (engine.go:118-136) degenerates to elementwise arithmetic.
+    """
+    cap = config.cap
+    k = config.max_fills
+    idx = jnp.arange(cap)
+    active = idx < opp_count
+    crosses_price = jnp.where(side == BUY, opp.price <= price, opp.price >= price)
+    crossing = active & (crosses_price | (is_market != 0))
+
+    clots = jnp.where(crossing, opp.lots, 0)
+    cum_excl = jnp.cumsum(clots) - clots
+    fill = jnp.clip(volume - cum_excl, 0, clots)
+    total = jnp.sum(fill)
+    remaining = volume - total
+
+    new_lots = opp.lots - fill
+    fully_filled = (fill > 0) & (new_lots == 0)  # a prefix of the array
+    n_removed = jnp.sum(fully_filled).astype(jnp.int32)
+    n_fills = jnp.sum(fill > 0).astype(jnp.int32)
+
+    # Fill records: fills occupy slots [0, n_fills) pre-compaction.
+    rec = slice(0, k)
+    taker_after = volume - (cum_excl[rec] + fill[rec])
+    out = dict(
+        fill_price=opp.price[rec],
+        fill_qty=fill[rec],
+        maker_oid=opp.oid[rec],
+        maker_uid=opp.uid[rec],
+        maker_prefill=opp.lots[rec],
+        maker_remaining=new_lots[rec],
+        taker_after=jnp.where(fill[rec] > 0, taker_after, 0),
+        n_fills=n_fills,
+        fill_overflow=jnp.maximum(n_fills - k, 0).astype(jnp.int32),
+    )
+
+    compacted = opp._replace(lots=new_lots).shift_left(n_removed, cap)
+    return compacted, opp_count - n_removed, remaining, out
+
+
+def _insert(config: BookConfig, own: _Side, own_count, entry: _Side, side):
+    """Rest the remainder at its own limit price (engine.go:69-83): insert
+    at the last slot whose priority beats or equals the new order — existing
+    same-price orders keep time priority (nodelink.go:53-64)."""
+    cap = config.cap
+    idx = jnp.arange(cap)
+    active = idx < own_count
+    beats = jnp.where(side == BUY, own.price >= entry.price, own.price <= entry.price)
+    pos = jnp.sum(active & beats).astype(jnp.int32)
+    overflow = own_count >= cap
+
+    src = jnp.clip(idx - 1, 0, cap - 1)
+
+    def ins(a, v):
+        shifted = jnp.where(idx > pos, a[src], a)
+        return jnp.where(idx == pos, jnp.asarray(v, a.dtype), shifted)
+
+    new = _Side(*(ins(a, v) for a, v in zip(own, entry)))
+    new = jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new, own)
+    return new, jnp.where(overflow, own_count, own_count + 1), overflow
+
+
+def _remove(config: BookConfig, own: _Side, own_count, oid, price):
+    """Cancel lookup + unlink (engine.go:87-116): requires the exact resting
+    price (SURVEY §2.3.2 — the reference looks up S:link:P by price); no
+    ownership check (uid is deliberately not compared)."""
+    cap = config.cap
+    idx = jnp.arange(cap)
+    active = idx < own_count
+    hit = active & (own.oid == oid) & (own.price == price)
+    found = jnp.any(hit)
+    pos = jnp.argmax(hit).astype(jnp.int32)  # oids unique by contract
+    volume = jnp.where(found, own.lots[pos], 0)
+
+    src = jnp.clip(idx + 1, 0, cap - 1)
+
+    def rm(a):
+        return jnp.where(
+            idx >= pos, jnp.where(idx + 1 < cap, a[src], jnp.zeros_like(a)), a
+        )
+
+    removed = _Side(*(rm(a) for a in own))
+    new = jax.tree.map(lambda n, o: jnp.where(found, n, o), removed, own)
+    return new, jnp.where(found, own_count - 1, own_count), found, volume
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def step(
+    config: BookConfig, book: BookState, op: DeviceOp
+) -> tuple[BookState, StepOutput]:
+    """Apply one op to one symbol's book. Pure, jittable, vmap-able.
+
+    Both the ADD path (match + rest) and the DEL path (cancel) are computed
+    unconditionally and mask-selected — under vmap over symbols `lax.cond`
+    would degenerate to the same thing, and branch-free code keeps the XLA
+    graph static (TPU design rule: no data-dependent control flow).
+    """
+    s = op.side
+    o = 1 - s
+    is_add = op.action == ACTION_ADD
+    is_del = op.action == ACTION_DEL
+
+    own0 = _side_of(book, s)
+    opp0 = _side_of(book, o)
+    own_count0 = book.count[s]
+    opp_count0 = book.count[o]
+
+    # --- ADD: match against the opposing side -------------------------------
+    opp1, opp_count1, remaining, fills = _match(
+        config, opp0, opp_count0, s, op.price, op.volume, op.is_market
+    )
+
+    # --- ADD: rest the remainder (limit only; market remainder is dropped —
+    # MARKET is our extension, the reference has no market orders) ----------
+    do_rest = is_add & (remaining > 0) & (op.is_market == 0)
+    entry = _Side(
+        price=op.price,
+        lots=remaining,
+        seq=book.next_seq + 1,
+        oid=op.oid,
+        uid=op.uid,
+    )
+    own1, own_count1, overflow = _insert(config, own0, own_count0, entry, s)
+
+    # --- DEL: cancel --------------------------------------------------------
+    own2, own_count2, found, cancel_volume = _remove(
+        config, own0, own_count0, op.oid, op.price
+    )
+
+    # --- select & write back ------------------------------------------------
+    def sel(add_side, del_side, nop_side):
+        return jax.tree.map(
+            lambda a, d, n: jnp.where(
+                is_add, a, jnp.where(is_del, d, n)
+            ),
+            add_side,
+            del_side,
+            nop_side,
+        )
+
+    own_final = sel(
+        jax.tree.map(lambda r, o_: jnp.where(do_rest, r, o_), own1, own0),
+        own2,
+        own0,
+    )
+    own_count_final = jnp.where(
+        is_add,
+        jnp.where(do_rest, own_count1, own_count0),
+        jnp.where(is_del, own_count2, own_count0),
+    )
+    opp_final = sel(opp1, opp0, opp0)
+    opp_count_final = jnp.where(is_add, opp_count1, opp_count0)
+
+    def write(arr, row_s, row_o):
+        return arr.at[s].set(row_s).at[o].set(row_o)
+
+    new_book = BookState(
+        price=write(book.price, own_final.price, opp_final.price),
+        lots=write(book.lots, own_final.lots, opp_final.lots),
+        seq=write(book.seq, own_final.seq, opp_final.seq),
+        oid=write(book.oid, own_final.oid, opp_final.oid),
+        uid=write(book.uid, own_final.uid, opp_final.uid),
+        count=book.count.at[s].set(own_count_final).at[o].set(opp_count_final),
+        next_seq=jnp.where(do_rest, book.next_seq + 1, book.next_seq),
+    )
+
+    zero = jnp.zeros((), config.dtype)
+    out = StepOutput(
+        fill_price=jnp.where(is_add, fills["fill_price"], 0),
+        fill_qty=jnp.where(is_add, fills["fill_qty"], 0),
+        maker_oid=jnp.where(is_add, fills["maker_oid"], 0),
+        maker_uid=jnp.where(is_add, fills["maker_uid"], 0),
+        maker_prefill=jnp.where(is_add, fills["maker_prefill"], 0),
+        maker_remaining=jnp.where(is_add, fills["maker_remaining"], 0),
+        taker_after=jnp.where(is_add, fills["taker_after"], 0),
+        n_fills=jnp.where(is_add, fills["n_fills"], 0),
+        fill_overflow=jnp.where(is_add, fills["fill_overflow"], 0),
+        taker_remaining=jnp.where(is_add, remaining, zero),
+        rested=(do_rest & ~overflow).astype(jnp.int32),
+        book_overflow=(do_rest & overflow).astype(jnp.int32),
+        cancel_found=(is_del & found).astype(jnp.int32),
+        cancel_volume=jnp.where(is_del, cancel_volume, zero),
+    )
+    return new_book, out

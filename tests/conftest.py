@@ -1,16 +1,24 @@
 """Test harness configuration.
 
-Forces JAX onto a virtual 8-device CPU platform BEFORE jax is imported so
-multi-chip sharding tests run without TPU hardware, and enables x64 so
-int64 tick/lot arithmetic is exact (SURVEY §2.2).
+Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding tests
+run without TPU hardware, and enables x64 so int64 tick/lot arithmetic is
+exact (SURVEY §2.2).
+
+Note: this image's sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon (the tunneled real TPU), so env vars alone are too late —
+the platform must be overridden via jax.config. XLA_FLAGS still works because
+the CPU backend initializes lazily, after this conftest runs.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,207 @@
+"""Parity tests: the JAX step function vs the Python oracle.
+
+The oracle (tests/test_oracle.py) is the executable spec of the reference's
+semantics; here identical order streams are replayed through both engines and
+the full MatchResult event streams plus final book depth must agree exactly
+(SURVEY §7 step 2; BASELINE metric "fill-price/qty parity").
+"""
+
+import jax
+import pytest
+
+from gome_tpu.engine import BookConfig, init_book, step
+from gome_tpu.engine.book import BUY, SALE, book_depth
+from gome_tpu.engine.host import Interner, OpContext, decode_events, encode_op
+from gome_tpu.fixed import scale
+from gome_tpu.oracle import OracleEngine
+from gome_tpu.types import Action, Order, OrderType, Side
+from gome_tpu.utils.streams import doorder_stream, mixed_stream
+
+
+class SingleSymbolHarness:
+    """Drives one symbol's device book from Python Orders (the per-test
+    stand-in for the host orchestrator)."""
+
+    def __init__(self, config: BookConfig):
+        self.config = config
+        self.book = init_book(config)
+        self.oids = Interner()
+        self.uids = Interner()
+        self._step = lambda b, op: step(config, b, op)
+        self.events = []
+
+    def process(self, order: Order):
+        op = encode_op(order, self.oids, self.uids)
+        self.book, out = self._step(self.book, op)
+        evs = decode_events(
+            OpContext(order), jax.device_get(out), self.config, self.oids, self.uids
+        )
+        self.events.extend(evs)
+        return evs
+
+    def depth(self, side: Side, max_levels: int = 32):
+        prices, volumes, n = jax.device_get(
+            book_depth(self.book, int(side), max_levels)
+        )
+        return [(int(prices[i]), int(volumes[i])) for i in range(int(n))]
+
+
+CFG = BookConfig(cap=128, max_fills=64)
+
+
+def run_both(orders, config=CFG):
+    oracle = OracleEngine()
+    harness = SingleSymbolHarness(config)
+    for i, order in enumerate(orders):
+        ev_o = oracle.process(order)
+        ev_j = harness.process(order)
+        assert ev_j == ev_o, (
+            f"event mismatch at order {i} ({order.oid}):\n"
+            f"oracle: {ev_o}\njax:    {ev_j}"
+        )
+    sym = orders[0].symbol
+    for side in (Side.BUY, Side.SALE):
+        assert harness.depth(side, config.cap) == oracle.book(sym).depth(side), (
+            f"final depth mismatch on {side}"
+        )
+    return oracle, harness
+
+
+def o(oid, side, price, volume, uuid="u1", action=Action.ADD, ot=OrderType.LIMIT):
+    return Order(
+        uuid=uuid,
+        oid=str(oid),
+        symbol="s",
+        side=side,
+        price=scale(price),
+        volume=scale(volume),
+        action=action,
+        order_type=ot,
+    )
+
+
+def test_rest_and_full_cross():
+    run_both([o(1, Side.SALE, 1.00, 0.5), o(2, Side.BUY, 1.10, 0.5)])
+
+
+def test_partial_fill_and_remainder_rests():
+    run_both(
+        [
+            o(1, Side.SALE, 1.00, 0.3),
+            o(2, Side.BUY, 1.05, 1.0),  # fills 0.3, rests 0.7 @ 1.05
+            o(3, Side.SALE, 1.05, 0.2),  # hits the rested remainder
+        ]
+    )
+
+
+def test_multi_level_depth_walk():
+    run_both(
+        [
+            o(1, Side.SALE, 1.00, 0.2),
+            o(2, Side.SALE, 1.01, 0.2),
+            o(3, Side.SALE, 1.02, 0.2),
+            o(4, Side.BUY, 1.05, 0.5),
+        ]
+    )
+
+
+def test_fifo_within_level():
+    run_both(
+        [
+            o(1, Side.SALE, 1.00, 0.2, uuid="a"),
+            o(2, Side.SALE, 1.00, 0.2, uuid="b"),
+            o(3, Side.SALE, 1.00, 0.2, uuid="c"),
+            o(4, Side.BUY, 1.00, 0.5),
+        ]
+    )
+
+
+def test_cancel_partial_then_refill():
+    run_both(
+        [
+            o(1, Side.SALE, 1.00, 1.0),
+            o(2, Side.BUY, 1.00, 0.4),
+            o(1, Side.SALE, 1.00, 1.0, action=Action.DEL),
+            o(3, Side.SALE, 1.00, 0.5),
+            o(4, Side.BUY, 1.00, 0.5),
+        ]
+    )
+
+
+def test_cancel_wrong_price_is_miss():
+    run_both(
+        [
+            o(1, Side.SALE, 1.00, 1.0),
+            o(1, Side.SALE, 1.01, 1.0, action=Action.DEL),
+        ]
+    )
+
+
+def test_market_order_walks_book_and_drops_remainder():
+    run_both(
+        [
+            o(1, Side.SALE, 1.00, 0.2),
+            o(2, Side.SALE, 5.00, 0.2),
+            o(3, Side.BUY, 0.0, 1.0, ot=OrderType.MARKET),
+            o(4, Side.BUY, 1.00, 0.1),  # book must be empty of asks now
+        ]
+    )
+
+
+def test_market_sell():
+    run_both(
+        [
+            o(1, Side.BUY, 1.00, 0.2),
+            o(2, Side.BUY, 0.50, 0.2),
+            o(3, Side.SALE, 9.99, 0.3, ot=OrderType.MARKET),
+        ]
+    )
+
+
+def test_doorder_stream_parity():
+    """The reference's own load shape (doorder.go:37-59), 400 orders."""
+    run_both(doorder_stream(n=400, seed=11), BookConfig(cap=512, max_fills=64))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_mixed_stream_with_cancels_parity(seed):
+    run_both(
+        mixed_stream(n=400, seed=seed, cancel_prob=0.25),
+        BookConfig(cap=512, max_fills=64),
+    )
+
+
+def test_mixed_stream_with_markets_parity():
+    run_both(
+        mixed_stream(n=300, seed=5, cancel_prob=0.15, market_prob=0.1),
+        BookConfig(cap=512, max_fills=64),
+    )
+
+
+def test_book_overflow_flagged_not_silent():
+    cfg = BookConfig(cap=4, max_fills=4)
+    h = SingleSymbolHarness(cfg)
+    for i in range(4):
+        h.process(o(i, Side.SALE, 2.00 + i / 100, 1.0))
+    op = encode_op(o(99, Side.SALE, 3.00, 1.0), h.oids, h.uids)
+    h.book, out = h._step(h.book, op)
+    assert int(out.book_overflow) == 1 and int(out.rested) == 0
+    assert h.depth(Side.SALE, 8) == [(scale(2.00 + i / 100), scale(1.0)) for i in range(4)]
+
+
+def test_fill_overflow_reported():
+    cfg = BookConfig(cap=16, max_fills=2)
+    h = SingleSymbolHarness(cfg)
+    for i in range(4):
+        h.process(o(i, Side.SALE, 1.00, 0.1))
+    op = encode_op(o(9, Side.BUY, 1.00, 0.4), h.oids, h.uids)
+    h.book, out = h._step(h.book, op)
+    assert int(out.n_fills) == 4 and int(out.fill_overflow) == 2
+    # Book state is still exact despite the record overflow.
+    assert h.depth(Side.SALE, 8) == []
+
+
+def test_volume_must_be_positive():
+    h = SingleSymbolHarness(CFG)
+    with pytest.raises(ValueError):
+        h.process(o(1, Side.BUY, 1.0, 0.0))
