@@ -1,0 +1,113 @@
+"""Benchmark: sustained device matching throughput, 10K-symbol exchange-scale
+load on one TPU chip (BASELINE.json config 4 shape; north star >= 1M
+orders/sec across 10K symbols on one v5e).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "orders/sec", "vs_baseline": N}
+
+vs_baseline: the reference publishes no numbers (BASELINE.json "published":
+{}), so the denominator is the north-star target itself — vs_baseline =
+value / 1e6, i.e. the fraction of the 1M orders/sec goal achieved.
+
+Method: S symbol lanes x T time slots of real limit orders (tight price
+band around mid so flows cross and match constantly), packed host-side with
+numpy, executed as G chained batch_step calls (scan over T x vmap over S)
+with donated book state. Orders/sec counts every non-NOP op applied to a
+book. Run `python bench.py --check` for a tiny self-check on any platform.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_grids(s, t, g, seed=0, dtype=np.int64):
+    """G full [S, T] grids of crossing limit-order flow around mid=1.00
+    (1e8 ticks at accuracy 8): uniform prices in ±0.5% of mid, volumes
+    1..100 lots-of-1e6, random sides. Every slot is a live order."""
+    rng = np.random.default_rng(seed)
+    grids = []
+    oid_base = 1
+    for _ in range(g):
+        price = rng.integers(99_500_000, 100_500_000, size=(s, t), dtype=dtype)
+        volume = rng.integers(1, 101, size=(s, t), dtype=dtype) * 1_000_000
+        side = rng.integers(0, 2, size=(s, t), dtype=np.int32)
+        action = np.ones((s, t), np.int32)
+        oid = (np.arange(s * t, dtype=dtype) + oid_base).reshape(s, t)
+        oid_base += s * t
+        uid = np.ones((s, t), dtype=dtype)
+        grids.append(
+            dict(
+                action=action, side=side,
+                is_market=np.zeros((s, t), np.int32),
+                price=price, volume=volume, oid=oid, uid=uid,
+            )
+        )
+    return grids
+
+
+def main():
+    check = "--check" in sys.argv
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    if check:
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp  # noqa: F401
+
+    from gome_tpu.engine import BookConfig, batch_step, init_books
+    from gome_tpu.engine.book import DeviceOp
+
+    S = int(os.environ.get("BENCH_SYMBOLS", 64 if check else 10240))
+    T = int(os.environ.get("BENCH_T", 4 if check else 16))
+    G = int(os.environ.get("BENCH_GRIDS", 2 if check else 12))
+    CAP = int(os.environ.get("BENCH_CAP", 32 if check else 128))
+    config = BookConfig(cap=CAP, max_fills=16)
+
+    stepper = jax.jit(
+        lambda books, ops: batch_step(config, books, ops),
+        donate_argnums=(0,),
+    )
+
+    books = init_books(config, S)
+    grids = [DeviceOp(**g) for g in build_grids(S, T, G + 2)]
+
+    # Warmup: compile + 2 grids (also fills books to steady state).
+    books, outs = stepper(books, grids[0])
+    books, outs = stepper(books, grids[1])
+    jax.block_until_ready(books)
+
+    t0 = time.perf_counter()
+    fills = 0
+    for grid in grids[2:]:
+        books, outs = stepper(books, grid)
+    total_fills = jax.device_get(outs.n_fills).sum()  # force final sync
+    jax.block_until_ready(books)
+    elapsed = time.perf_counter() - t0
+
+    orders = S * T * G
+    throughput = orders / elapsed
+    result = {
+        "metric": f"device matching throughput, {S} symbols x {T}-deep grids, cap={CAP}, int64 ticks",
+        "value": round(throughput),
+        "unit": "orders/sec",
+        "vs_baseline": round(throughput / 1_000_000, 3),
+    }
+    print(json.dumps(result))
+    if os.environ.get("BENCH_VERBOSE"):
+        print(
+            f"# elapsed={elapsed:.3f}s orders={orders} "
+            f"last_grid_fills={int(total_fills)} platform="
+            f"{jax.devices()[0].platform}",
+            file=sys.stderr,
+        )
+
+
+if __name__ == "__main__":
+    main()

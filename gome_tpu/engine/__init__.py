@@ -1,11 +1,16 @@
-from .book import BookConfig, BookState, DeviceOp, StepOutput, init_book
-from .step import step
+from .batch import BatchEngine, batch_step
+from .book import BookConfig, BookState, DeviceOp, StepOutput, init_book, init_books
+from .step import step, step_impl
 
 __all__ = [
+    "BatchEngine",
     "BookConfig",
     "BookState",
     "DeviceOp",
     "StepOutput",
+    "batch_step",
     "init_book",
+    "init_books",
     "step",
+    "step_impl",
 ]

@@ -1,0 +1,173 @@
+"""Batched execution: scan over time within a symbol, vmap across symbols.
+
+This is the execution model that replaces the reference's one-order-at-a-time
+consumer loop (rabbitmq.go:116-125): the host packs a micro-batch of orders
+into a dense [S, T] op grid — S symbol lanes, T time slots, NOP-padded — and
+the device applies all of it in one compiled call:
+
+    books'[s], outs[s, :] = scan(step, books[s], ops[s, :])   for all s (vmap)
+
+Two invariants make this exactly equivalent to sequential processing:
+  * same-symbol operations never split across concurrent lanes and keep
+    arrival order within the lane (SURVEY §5.2: the serialized-per-symbol
+    invariant, the reference's correctness-by-single-threadedness);
+  * symbols share nothing (SURVEY §2.1), so cross-symbol interleaving is
+    irrelevant to book state — the host re-sorts decoded events by original
+    arrival index to reproduce the reference's global emission order.
+
+The [S] symbol axis is also the sharding axis: lanes are independent, so
+pjit partitions the whole grid across chips with zero collectives
+(gome_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from ..types import Action, MatchResult, Order
+from .book import BookConfig, BookState, DeviceOp, StepOutput, init_books
+from .host import Interner, OpContext, decode_events, encode_op
+from .step import step_impl
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def batch_step(
+    config: BookConfig, books: BookState, ops: DeviceOp
+) -> tuple[BookState, StepOutput]:
+    """books: [S, ...] stacked BookState; ops: DeviceOp with [S, T] leaves.
+    Returns updated books and [S, T]-shaped StepOutputs."""
+
+    def per_symbol(book, ops_lane):
+        return jax.lax.scan(
+            lambda b, op: step_impl(config, b, op), book, ops_lane
+        )
+
+    return jax.vmap(per_symbol)(books, ops)
+
+
+def _nop_grid(config: BookConfig, n_slots: int, t: int) -> dict[str, np.ndarray]:
+    i32 = lambda: np.zeros((n_slots, t), np.int32)
+    val = lambda: np.zeros((n_slots, t), np.dtype(config.dtype))
+    return dict(
+        action=i32(), side=i32(), is_market=i32(),
+        price=val(), volume=val(), oid=val(), uid=val(),
+    )
+
+
+class BatchOverflowError(Exception):
+    """One or more ops in a micro-batch overflowed fixed device budgets
+    (fill records or book capacity). The batch's book mutations are already
+    committed on device; everything recoverable is attached:
+
+      events   — the full decoded event stream for every non-overflowing op
+      failures — [(order, reason), ...] for the overflowing ops
+    """
+
+    def __init__(self, events, failures):
+        self.events = events
+        self.failures = failures
+        super().__init__(
+            f"{len(failures)} op(s) overflowed device budgets: "
+            + "; ".join(f"{o.oid}: {r}" for o, r in failures[:3])
+        )
+
+
+class BatchEngine:
+    """Host-side driver for the batched device engine.
+
+    Owns the device-resident [S] book stack, the symbol->lane mapping, and
+    the id interners; packs order lists into op grids and decodes StepOutputs
+    back into the global MatchResult event stream.
+
+    This layer assumes orders already passed admission (pre-pool checks live
+    in the orchestrator above — gome_tpu.bridge); every ADD given here hits
+    the book.
+    """
+
+    def __init__(self, config: BookConfig, n_slots: int, max_t: int = 32):
+        self.config = config
+        self.n_slots = n_slots
+        self.max_t = max_t
+        self.books = init_books(config, n_slots)
+        self.symbols = Interner()  # symbol -> lane id + 1 offset handled below
+        self.oids = Interner()
+        self.uids = Interner()
+
+    def _lane(self, symbol: str) -> int:
+        lane = self.symbols.intern(symbol) - 1  # Interner ids start at 1
+        if lane >= self.n_slots:
+            raise ValueError(
+                f"symbol {symbol!r} needs lane {lane} but engine has "
+                f"n_slots={self.n_slots}"
+            )
+        return lane
+
+    def process(self, orders: list[Order]) -> list[MatchResult]:
+        """Apply a micro-batch. Symbols with more than max_t ops are drained
+        over several device calls (order preserved); returns all events in
+        original arrival order.
+
+        Raises BatchOverflowError (with all other ops' events attached) if
+        any op exceeded the fill-record or book-capacity budget — the device
+        book state is exact either way; only that op's event records (or its
+        resting remainder) need the host slow path."""
+        pending = [(i, o) for i, o in enumerate(orders)]
+        decoded: list[tuple[int, list[MatchResult]]] = []
+        failures: list[tuple[Order, str]] = []
+        while pending:
+            pending = self._one_grid(pending, decoded, failures)
+        decoded.sort(key=lambda kv: kv[0])
+        events = [ev for _, evs in decoded for ev in evs]
+        if failures:
+            raise BatchOverflowError(events, failures)
+        return events
+
+    def _one_grid(self, pending, decoded, failures):
+        grid = _nop_grid(self.config, self.n_slots, self.max_t)
+        contexts: dict[tuple[int, int], tuple[int, Order]] = {}
+        fill_level: dict[int, int] = {}
+        leftover: list[tuple[int, Order]] = []
+        blocked: set[int] = set()  # lanes whose FIFO order must not be broken
+
+        for arrival, order in pending:
+            lane = self._lane(order.symbol)
+            t = fill_level.get(lane, 0)
+            if lane in blocked or t >= self.max_t:
+                blocked.add(lane)
+                leftover.append((arrival, order))
+                continue
+            op = encode_op(order, self.oids, self.uids, self.config.dtype)
+            for name, arr in grid.items():
+                arr[lane, t] = getattr(op, name)
+            contexts[(lane, t)] = (arrival, order)
+            fill_level[lane] = t + 1
+
+        ops = DeviceOp(**{k: v for k, v in grid.items()})
+        self.books, outs = batch_step(self.config, self.books, ops)
+        outs = jax.device_get(outs)
+        for (lane, t), (arrival, order) in contexts.items():
+            out = jax.tree.map(lambda a: a[lane, t], outs)
+            try:
+                decoded.append(
+                    (
+                        arrival,
+                        decode_events(
+                            OpContext(order), out, self.config, self.oids, self.uids
+                        ),
+                    )
+                )
+            except OverflowError as exc:
+                # Don't lose unrelated ops' events over one overflow; the
+                # caller gets everything recoverable via BatchOverflowError.
+                failures.append((order, str(exc)))
+        return leftover
+
+    # -- views -------------------------------------------------------------
+    def lane_books(self) -> BookState:
+        return jax.device_get(self.books)
+
+    def symbol_lane(self, symbol: str) -> int:
+        return self._lane(symbol)

@@ -50,21 +50,25 @@ class OpContext:
     order: Order
 
 
-def encode_op(order: Order, oids: Interner, uids: Interner) -> DeviceOp:
-    """Order -> scalar DeviceOp (numpy scalars; cheap to batch later)."""
+def encode_op(
+    order: Order, oids: Interner, uids: Interner, dtype=np.int64
+) -> DeviceOp:
+    """Order -> scalar DeviceOp (numpy scalars; cheap to batch later).
+    dtype must match BookConfig.dtype so the device writeback needs no cast."""
     if order.action is Action.ADD and order.volume <= 0:
         raise ValueError(
             f"volume must be positive, got {order.volume} (oid={order.oid}); "
             "volume<=0 is out of contract (see gome_tpu.oracle docstring)"
         )
+    val = np.dtype(dtype).type
     return DeviceOp(
         action=np.int32(int(order.action)),  # Action values == device codes
         side=np.int32(int(order.side)),
         is_market=np.int32(order.order_type is OrderType.MARKET),
-        price=np.int64(order.price),
-        volume=np.int64(order.volume),
-        oid=np.int64(oids.intern(order.oid)),
-        uid=np.int64(uids.intern(order.uuid)),
+        price=val(order.price),
+        volume=val(order.volume),
+        oid=val(oids.intern(order.oid)),
+        uid=val(uids.intern(order.uuid)),
     )
 
 

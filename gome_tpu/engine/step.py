@@ -161,8 +161,7 @@ def _remove(config: BookConfig, own: _Side, own_count, oid, price):
     return new, jnp.where(found, own_count - 1, own_count), found, volume
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def step(
+def step_impl(
     config: BookConfig, book: BookState, op: DeviceOp
 ) -> tuple[BookState, StepOutput]:
     """Apply one op to one symbol's book. Pure, jittable, vmap-able.
@@ -259,3 +258,8 @@ def step(
         cancel_volume=jnp.where(is_del, cancel_volume, zero),
     )
     return new_book, out
+
+
+# Jitted entry point for single-op use (tests, debugging). Batched execution
+# nests step_impl under scan/vmap instead (gome_tpu.engine.batch).
+step = functools.partial(jax.jit, static_argnums=0)(step_impl)

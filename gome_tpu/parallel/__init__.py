@@ -1,0 +1,13 @@
+from .mesh import (
+    make_mesh,
+    shard_batch,
+    sharded_batch_step,
+    symbol_sharding,
+)
+
+__all__ = [
+    "make_mesh",
+    "shard_batch",
+    "sharded_batch_step",
+    "symbol_sharding",
+]

@@ -1,0 +1,75 @@
+"""Multi-chip scaling: symbol-sharded books over a device mesh.
+
+The reference's only parallelism axis is per-symbol independence — every
+Redis key is symbol-prefixed and symbols share nothing (SURVEY §2.1). The
+TPU equivalent: the [S] symbol-lane axis of the stacked BookState/op grids is
+partitioned across a 1-D `jax.sharding.Mesh` ("sym" axis). Matching needs
+ZERO collectives — XLA partitions the batched scan x vmap step into S/D
+independent lanes per chip; cross-chip traffic exists only at the dispatch
+layer (host routes orders to the chip owning the symbol's lane — the
+EP-style symbol-hash routing of SURVEY §2.1) and for global metrics
+reductions (psum over "sym").
+
+Multi-host: the same mesh spans hosts; lane routing keys on
+lane // lanes_per_shard so each host's bridge feeds only its local shard and
+order traffic rides DCN at the dispatch layer, never inside the step
+(SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.book import BookConfig, BookState, DeviceOp
+from ..engine.batch import batch_step
+
+SYM_AXIS = "sym"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the symbol axis. n_devices must divide the lane count
+    used with it."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SYM_AXIS,))
+
+
+def symbol_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for any array whose leading axis is the symbol-lane axis
+    (every BookState leaf and every DeviceOp grid leaf)."""
+    return NamedSharding(mesh, P(SYM_AXIS))
+
+
+def shard_batch(mesh: Mesh, tree):
+    """Place a [S, ...]-leaved pytree (BookState stack or DeviceOp grid)
+    with the leading axis split across the mesh."""
+    return jax.device_put(tree, symbol_sharding(mesh))
+
+
+def sharded_batch_step(config: BookConfig, mesh: Mesh):
+    """The batched step with explicit symbol-axis shardings pinned on inputs
+    and outputs — the full multi-chip matching step. Compiles to per-chip
+    independent lane scans with no communication.
+    """
+    sharding = symbol_sharding(mesh)
+
+    def stepper(books: BookState, ops: DeviceOp):
+        return batch_step(config, books, ops)
+
+    return jax.jit(
+        stepper,
+        in_shardings=(sharding, sharding),
+        out_shardings=(sharding, sharding),
+    )
+
+
+def global_fill_rate(outs) -> jax.Array:
+    """Example cross-chip reduction: total fills in a batch (a psum over the
+    sharded lane axis, handled by XLA from the jnp.sum)."""
+    import jax.numpy as jnp
+
+    return jnp.sum(outs.n_fills)

@@ -6,19 +6,12 @@ exact (SURVEY §2.2).
 
 Note: this image's sitecustomize imports jax at interpreter startup with
 JAX_PLATFORMS=axon (the tunneled real TPU), so env vars alone are too late —
-the platform must be overridden via jax.config. XLA_FLAGS still works because
-the CPU backend initializes lazily, after this conftest runs.
+platform and device count must be set via jax.config before the (lazy) first
+backend initialization, which is why this conftest does it at import time.
 """
 
-import os
+import jax
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
+jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,166 @@
+"""Parity tests for the batched (scan x vmap) engine vs the Python oracle.
+
+The batched engine must reproduce the reference's sequential global event
+stream exactly — including cross-symbol interleaving by arrival order —
+despite executing S symbol lanes in parallel (SURVEY §5.2 serialized-
+per-symbol invariant)."""
+
+import pytest
+
+from gome_tpu.engine import BatchEngine, BookConfig
+from gome_tpu.fixed import scale
+from gome_tpu.oracle import OracleEngine
+from gome_tpu.types import Action, Order, Side
+from gome_tpu.utils.streams import mixed_stream, multi_symbol_stream
+
+CFG = BookConfig(cap=128, max_fills=32)
+
+
+def run_parity(orders, n_slots=16, max_t=8, config=CFG, chunk=50):
+    oracle = OracleEngine()
+    engine = BatchEngine(config, n_slots=n_slots, max_t=max_t)
+    for start in range(0, len(orders), chunk):
+        batch = orders[start : start + chunk]
+        expected = []
+        for order in batch:
+            expected.extend(oracle.process(order))
+        got = engine.process(batch)
+        assert got == expected, f"batch starting at {start} diverged"
+    # Final per-symbol depth must also agree.
+    from gome_tpu.engine.book import book_depth
+    import jax
+
+    for symbol, book in oracle.books.items():
+        lane = engine.symbol_lane(symbol)
+        lane_state = jax.tree.map(lambda a: a[lane], engine.books)
+        for side in (Side.BUY, Side.SALE):
+            prices, volumes, n = jax.device_get(
+                book_depth(lane_state, int(side), config.cap)
+            )
+            got_depth = [(int(prices[i]), int(volumes[i])) for i in range(int(n))]
+            assert got_depth == book.depth(side), f"{symbol}/{side} depth"
+    return engine, oracle
+
+
+def test_two_symbol_interleaved_stream():
+    def o(oid, sym, side, p, v):
+        return Order(
+            uuid="u", oid=str(oid), symbol=sym, side=side,
+            price=scale(p), volume=scale(v),
+        )
+
+    orders = [
+        o(1, "aaa", Side.SALE, 1.00, 0.5),
+        o(2, "bbb", Side.SALE, 2.00, 0.5),
+        o(3, "aaa", Side.BUY, 1.00, 0.3),
+        o(4, "bbb", Side.BUY, 2.50, 0.7),
+        o(5, "aaa", Side.BUY, 1.00, 0.4),
+    ]
+    engine, oracle = run_parity(orders, n_slots=4, max_t=4)
+
+
+def test_multi_symbol_poisson_parity():
+    """BASELINE config 3 shape (downscaled): uniform multi-symbol flow."""
+    orders = multi_symbol_stream(n=600, n_symbols=12, seed=4, cancel_prob=0.15)
+    run_parity(orders, n_slots=16, max_t=8)
+
+
+def test_multi_symbol_zipf_parity():
+    """BASELINE config 4 shape (downscaled): Zipf-skewed arrival rates.
+    The hot symbol overflows max_t per grid, exercising the drain loop."""
+    orders = multi_symbol_stream(
+        n=500, n_symbols=20, seed=9, zipf_a=1.2, cancel_prob=0.1
+    )
+    run_parity(orders, n_slots=24, max_t=4)
+
+
+def test_single_symbol_batch_matches_sequential():
+    """All orders on one lane: batch must equal pure sequential semantics."""
+    orders = mixed_stream(n=300, seed=2, cancel_prob=0.2)
+    run_parity(orders, n_slots=2, max_t=8, chunk=64)
+
+
+def test_lane_overflow_error():
+    engine = BatchEngine(CFG, n_slots=2, max_t=4)
+    orders = [
+        Order(
+            uuid="u", oid=str(i), symbol=f"s{i}", side=Side.BUY,
+            price=scale(1.0), volume=scale(1.0),
+        )
+        for i in range(3)
+    ]
+    with pytest.raises(ValueError, match="n_slots"):
+        engine.process(orders)
+
+
+def test_max_t_spill_preserves_fifo():
+    """7 same-symbol ops with max_t=2 forces 4 grids; FIFO must hold."""
+    def o(oid, side, p, v, action=Action.ADD):
+        return Order(
+            uuid="u", oid=str(oid), symbol="s", side=side,
+            price=scale(p), volume=scale(v), action=action,
+        )
+
+    orders = [
+        o(1, Side.SALE, 1.00, 0.2),
+        o(2, Side.SALE, 1.00, 0.2),
+        o(3, Side.SALE, 1.00, 0.2),
+        o(4, Side.BUY, 1.00, 0.5),  # fills 1 fully, 2 fully, 3 partially
+        o(2, Side.SALE, 1.00, 0.2, Action.DEL),  # already filled -> miss
+        o(3, Side.SALE, 1.00, 0.2, Action.DEL),  # cancels remaining 0.1
+        o(5, Side.BUY, 1.00, 0.3),  # book now empty -> rests
+    ]
+    run_parity(orders, n_slots=2, max_t=2, chunk=len(orders))
+
+
+def test_int32_book_mode():
+    """BookConfig(dtype=int32) must run without unsafe casts (lots/prices in
+    int32 range; cumsum stays below 2^31 with small volumes)."""
+    import jax.numpy as jnp
+
+    cfg32 = BookConfig(cap=32, max_fills=8, dtype=jnp.int32)
+    engine = BatchEngine(cfg32, n_slots=4, max_t=4)
+    orders = [
+        Order(uuid="u", oid="1", symbol="s", side=Side.SALE, price=100, volume=5),
+        Order(uuid="u", oid="2", symbol="s", side=Side.BUY, price=100, volume=3),
+    ]
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # unsafe-cast FutureWarning -> error
+        events = engine.process(orders)
+    assert len(events) == 1 and events[0].match_volume == 3
+    assert engine.books.price.dtype == jnp.int32
+
+
+def test_batch_overflow_collects_other_events():
+    """One op overflowing max_fills must not destroy the rest of the batch's
+    event stream (BatchOverflowError carries it)."""
+    from gome_tpu.engine.batch import BatchOverflowError
+
+    cfg = BookConfig(cap=32, max_fills=2)
+    engine = BatchEngine(cfg, n_slots=4, max_t=8)
+
+    def o(oid, sym, side, p, v):
+        return Order(
+            uuid="u", oid=str(oid), symbol=sym, side=side,
+            price=scale(p), volume=scale(v),
+        )
+
+    orders = [
+        # lane "a": 4 small asks then a buy crossing all 4 -> 4 fills > K=2
+        o(1, "a", Side.SALE, 1.00, 0.1),
+        o(2, "a", Side.SALE, 1.00, 0.1),
+        o(3, "a", Side.SALE, 1.00, 0.1),
+        o(4, "a", Side.SALE, 1.00, 0.1),
+        o(5, "a", Side.BUY, 1.00, 0.4),
+        # lane "b": a clean single fill that must survive
+        o(6, "b", Side.SALE, 2.00, 0.5),
+        o(7, "b", Side.BUY, 2.00, 0.5),
+    ]
+    with pytest.raises(BatchOverflowError) as exc_info:
+        engine.process(orders)
+    err = exc_info.value
+    assert len(err.failures) == 1 and err.failures[0][0].oid == "5"
+    b_fills = [ev for ev in err.events if ev.node.symbol == "b"]
+    assert len(b_fills) == 1 and b_fills[0].match_volume == scale(0.5)
