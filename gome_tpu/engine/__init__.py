@@ -1,9 +1,12 @@
-from .batch import BatchEngine, batch_step
+from .batch import BatchEngine, EngineStats, batch_step
 from .book import BookConfig, BookState, DeviceOp, StepOutput, init_book, init_books
+from .orchestrator import MatchEngine
 from .step import step, step_impl
 
 __all__ = [
     "BatchEngine",
+    "EngineStats",
+    "MatchEngine",
     "BookConfig",
     "BookState",
     "DeviceOp",

@@ -15,6 +15,13 @@ Two invariants make this exactly equivalent to sequential processing:
     irrelevant to book state — the host re-sorts decoded events by original
     arrival index to reproduce the reference's global emission order.
 
+Fixed device budgets (book capacity, K fill records) never cost exactness:
+the engine keeps the pre-batch book snapshot and, when a budget trips,
+escalates — grows the book slot axis and re-runs the whole grid, or re-runs
+one lane with a larger record budget — before decoding (SURVEY §7 hard
+parts (a)/(c): overflow is recovered, never silently dropped; the reference
+has no budgets because Redis is unbounded).
+
 The [S] symbol axis is also the sharding axis: lanes are independent, so
 pjit partitions the whole grid across chips with zero collectives
 (gome_tpu.parallel).
@@ -22,15 +29,30 @@ pjit partitions the whole grid across chips with zero collectives
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
 import numpy as np
 
 from ..types import Action, MatchResult, Order
-from .book import BookConfig, BookState, DeviceOp, StepOutput, init_books
+from .book import (
+    BookConfig,
+    BookState,
+    DeviceOp,
+    StepOutput,
+    grow_books,
+    grow_lanes,
+    init_books,
+)
 from .host import Interner, OpContext, decode_events, encode_op
 from .step import step_impl
+
+
+def _lane_scan_impl(config: BookConfig, book: BookState, ops_lane: DeviceOp):
+    """One symbol's op sequence on one (unstacked) book — the single shared
+    scan body for both the full grid (under vmap) and escalation re-runs."""
+    return jax.lax.scan(lambda b, op: step_impl(config, b, op), book, ops_lane)
 
 
 @functools.partial(jax.jit, static_argnums=0)
@@ -39,13 +61,10 @@ def batch_step(
 ) -> tuple[BookState, StepOutput]:
     """books: [S, ...] stacked BookState; ops: DeviceOp with [S, T] leaves.
     Returns updated books and [S, T]-shaped StepOutputs."""
+    return jax.vmap(lambda b, o: _lane_scan_impl(config, b, o))(books, ops)
 
-    def per_symbol(book, ops_lane):
-        return jax.lax.scan(
-            lambda b, op: step_impl(config, b, op), book, ops_lane
-        )
 
-    return jax.vmap(per_symbol)(books, ops)
+lane_scan = functools.partial(jax.jit, static_argnums=0)(_lane_scan_impl)
 
 
 def _nop_grid(config: BookConfig, n_slots: int, t: int) -> dict[str, np.ndarray]:
@@ -57,22 +76,28 @@ def _nop_grid(config: BookConfig, n_slots: int, t: int) -> dict[str, np.ndarray]
     )
 
 
-class BatchOverflowError(Exception):
-    """One or more ops in a micro-batch overflowed fixed device budgets
-    (fill records or book capacity). The batch's book mutations are already
-    committed on device; everything recoverable is attached:
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
 
-      events   — the full decoded event stream for every non-overflowing op
-      failures — [(order, reason), ...] for the overflowing ops
-    """
 
-    def __init__(self, events, failures):
-        self.events = events
-        self.failures = failures
-        super().__init__(
-            f"{len(failures)} op(s) overflowed device budgets: "
-            + "; ".join(f"{o.oid}: {r}" for o, r in failures[:3])
-        )
+@dataclasses.dataclass
+class EngineStats:
+    """Host-side engine counters (new instrumentation — the reference has
+    none, SURVEY §5.5). Escalations are exact-but-slow events worth watching:
+    frequent cap growth means the configured book geometry is undersized."""
+
+    orders: int = 0
+    fills: int = 0
+    cancels: int = 0
+    cancels_missed: int = 0
+    dropped_no_prepool: int = 0  # incremented by the orchestrator facade
+    device_calls: int = 0
+    cap_escalations: int = 0
+    fill_record_escalations: int = 0
+    lane_growths: int = 0
 
 
 class BatchEngine:
@@ -83,49 +108,61 @@ class BatchEngine:
     back into the global MatchResult event stream.
 
     This layer assumes orders already passed admission (pre-pool checks live
-    in the orchestrator above — gome_tpu.bridge); every ADD given here hits
-    the book.
+    in the orchestrator above — gome_tpu.engine.orchestrator); every ADD
+    given here hits the book.
     """
 
-    def __init__(self, config: BookConfig, n_slots: int, max_t: int = 32):
+    def __init__(
+        self,
+        config: BookConfig,
+        n_slots: int,
+        max_t: int = 32,
+        auto_grow: bool = True,
+    ):
         self.config = config
         self.n_slots = n_slots
         self.max_t = max_t
+        self.auto_grow = auto_grow
         self.books = init_books(config, n_slots)
         self.symbols = Interner()  # symbol -> lane id + 1 offset handled below
         self.oids = Interner()
         self.uids = Interner()
+        self.stats = EngineStats()
 
     def _lane(self, symbol: str) -> int:
         lane = self.symbols.intern(symbol) - 1  # Interner ids start at 1
         if lane >= self.n_slots:
-            raise ValueError(
-                f"symbol {symbol!r} needs lane {lane} but engine has "
-                f"n_slots={self.n_slots}"
-            )
+            if not self.auto_grow:
+                raise ValueError(
+                    f"symbol {symbol!r} needs lane {lane} but engine has "
+                    f"n_slots={self.n_slots} (auto_grow disabled)"
+                )
+            new_slots = max(self.n_slots * 2, lane + 1)
+            self.books = grow_lanes(self.books, new_slots)
+            self.n_slots = new_slots
+            self.stats.lane_growths += 1
         return lane
 
     def process(self, orders: list[Order]) -> list[MatchResult]:
         """Apply a micro-batch. Symbols with more than max_t ops are drained
         over several device calls (order preserved); returns all events in
-        original arrival order.
-
-        Raises BatchOverflowError (with all other ops' events attached) if
-        any op exceeded the fill-record or book-capacity budget — the device
-        book state is exact either way; only that op's event records (or its
-        resting remainder) need the host slow path."""
+        original arrival order. Device-budget overflows are escalated
+        internally (see module docstring) — results are always exact."""
         pending = [(i, o) for i, o in enumerate(orders)]
         decoded: list[tuple[int, list[MatchResult]]] = []
-        failures: list[tuple[Order, str]] = []
         while pending:
-            pending = self._one_grid(pending, decoded, failures)
+            pending = self._one_grid(pending, decoded)
         decoded.sort(key=lambda kv: kv[0])
+        self.stats.orders += len(orders)
         events = [ev for _, evs in decoded for ev in evs]
-        if failures:
-            raise BatchOverflowError(events, failures)
+        for ev in events:
+            if ev.is_cancel:
+                self.stats.cancels += 1
+            else:
+                self.stats.fills += 1
         return events
 
-    def _one_grid(self, pending, decoded, failures):
+    def _one_grid(self, pending, decoded):
         grid = _nop_grid(self.config, self.n_slots, self.max_t)
         contexts: dict[tuple[int, int], tuple[int, Order]] = {}
         fill_level: dict[int, int] = {}
@@ -134,6 +171,9 @@ class BatchEngine:
 
         for arrival, order in pending:
             lane = self._lane(order.symbol)
+            if lane >= grid["action"].shape[0]:
+                # Lane created by auto-grow mid-packing; defer to next grid.
+                blocked.add(lane)
             t = fill_level.get(lane, 0)
             if lane in blocked or t >= self.max_t:
                 blocked.add(lane)
@@ -145,29 +185,95 @@ class BatchEngine:
             contexts[(lane, t)] = (arrival, order)
             fill_level[lane] = t + 1
 
+        if self.n_slots > grid["action"].shape[0]:
+            # Lanes were auto-grown while packing; pad the grid with NOP rows
+            # so ops and the (already-grown) book stack agree on S.
+            extra = self.n_slots - grid["action"].shape[0]
+            grid = {
+                k: np.pad(v, [(0, extra), (0, 0)]) for k, v in grid.items()
+            }
         ops = DeviceOp(**{k: v for k, v in grid.items()})
-        self.books, outs = batch_step(self.config, self.books, ops)
-        outs = jax.device_get(outs)
+        outs, lane_overrides = self._run_exact(ops, contexts)
         for (lane, t), (arrival, order) in contexts.items():
-            out = jax.tree.map(lambda a: a[lane, t], outs)
-            try:
-                decoded.append(
-                    (
-                        arrival,
-                        decode_events(
-                            OpContext(order), out, self.config, self.oids, self.uids
-                        ),
-                    )
-                )
-            except OverflowError as exc:
-                # Don't lose unrelated ops' events over one overflow; the
-                # caller gets everything recoverable via BatchOverflowError.
-                failures.append((order, str(exc)))
+            src = lane_overrides.get(lane)
+            if src is not None:
+                out = jax.tree.map(lambda a: a[t], src)
+            else:
+                out = jax.tree.map(lambda a: a[lane, t], outs)
+            events = decode_events(
+                OpContext(order), out, self.oids, self.uids
+            )
+            if order.action is Action.DEL and not events:
+                self.stats.cancels_missed += 1
+            decoded.append((arrival, events))
         return leftover
+
+    def _run_exact(self, ops: DeviceOp, contexts):
+        """Run one grid, escalating device budgets until nothing overflowed.
+
+        Returns (outs, lane_overrides): the committed [S, T] outputs plus,
+        for lanes whose fill records were truncated at the grid's K, a
+        re-decoded [T] StepOutput with a large-enough record budget.
+        """
+        books_before = self.books  # immutable on device; cheap to retain
+
+        # Phase 1: book capacity. A tripped `book_overflow` means a resting
+        # insert was dropped — the book state is NOT what the sequential
+        # semantics require, so grow the slot axis and replay the whole grid
+        # from the snapshot (exact: active slots are a prefix; padding is
+        # invisible to matching). The required cap is bounded host-side
+        # before replaying — current resting count plus the ADDs packed into
+        # the lane — so escalation costs one replay, not a doubling loop.
+        while True:
+            new_books, outs = batch_step(self.config, books_before, ops)
+            self.stats.device_calls += 1
+            host_flags = np.asarray(jax.device_get(outs.book_overflow))
+            if not host_flags.any():
+                break
+            self.stats.cap_escalations += 1
+            counts = np.asarray(jax.device_get(books_before.count))  # [S, 2]
+            adds_per_lane = np.sum(np.asarray(ops.action) == 1, axis=1)  # [S]
+            bound = int((counts.max(axis=1) + adds_per_lane).max())
+            new_cap = _next_pow2(max(bound, self.config.cap + 1))
+            books_before = grow_books(books_before, new_cap)
+            self.config = dataclasses.replace(self.config, cap=new_cap)
+        self.books = new_books
+        outs = jax.device_get(outs)
+
+        # Phase 2: fill records. n_fills > K truncated this op's *records*
+        # only — the book transition is exact either way — so re-run just the
+        # affected lanes from the snapshot with K' >= max fills observed.
+        # n_fills <= resting orders crossed <= cap, so K' <= cap and the
+        # set of escalated compile shapes is bounded by log2(cap).
+        lane_overrides: dict[int, StepOutput] = {}
+        n_fills = np.asarray(outs.n_fills)
+        overflowed = sorted(
+            {
+                lane
+                for (lane, t) in contexts
+                if n_fills[lane, t] > self.config.max_fills
+            }
+        )
+        for lane in overflowed:
+            self.stats.fill_record_escalations += 1
+            k = min(_next_pow2(int(n_fills[lane].max())), self.config.cap)
+            big = dataclasses.replace(self.config, max_fills=k)
+            lane_book = jax.tree.map(lambda a: a[lane], books_before)
+            lane_ops = jax.tree.map(lambda a: a[lane], ops)
+            _, lane_out = lane_scan(big, lane_book, lane_ops)
+            self.stats.device_calls += 1
+            lane_overrides[lane] = jax.device_get(lane_out)
+        return outs, lane_overrides
 
     # -- views -------------------------------------------------------------
     def lane_books(self) -> BookState:
         return jax.device_get(self.books)
 
     def symbol_lane(self, symbol: str) -> int:
-        return self._lane(symbol)
+        """Read-only lookup: the lane owning `symbol`. Raises KeyError for a
+        symbol the engine has never processed (unlike _lane, this never
+        interns or grows device state)."""
+        i = self.symbols.get(symbol)
+        if i is None:
+            raise KeyError(f"unknown symbol {symbol!r}")
+        return i - 1

@@ -137,6 +137,48 @@ def init_books(config: BookConfig, n_symbols: int) -> BookState:
     )
 
 
+def grow_books(books: BookState, new_cap: int) -> BookState:
+    """Widen the slot axis of a book (or stacked-book) pytree to `new_cap`,
+    zero-padding the tail. Active slots are a prefix (book.py invariant), so
+    padding on the right preserves every book exactly — this is the host
+    "spill" escape hatch for the fixed-width ladder (SURVEY §5.7): when a
+    side fills up (`book_overflow`), the engine re-runs the batch from the
+    pre-batch snapshot on grown books instead of dropping the insert.
+    """
+    cap = books.price.shape[-1]
+    if new_cap < cap:
+        raise ValueError(f"cannot shrink cap {cap} -> {new_cap}")
+    if new_cap == cap:
+        return books
+    pad = [(0, 0)] * (books.price.ndim - 1) + [(0, new_cap - cap)]
+
+    def widen(a):
+        return jnp.pad(a, pad)
+
+    return books._replace(
+        price=widen(books.price),
+        lots=widen(books.lots),
+        seq=widen(books.seq),
+        oid=widen(books.oid),
+        uid=widen(books.uid),
+    )
+
+
+def grow_lanes(books: BookState, n_lanes: int) -> BookState:
+    """Append empty symbol lanes to a stacked [S, ...] book pytree (used when
+    more distinct symbols arrive than the engine was provisioned for —
+    the reference has no such limit because Redis keys are dynamic)."""
+    s = books.count.shape[0]
+    if n_lanes < s:
+        raise ValueError(f"cannot shrink lanes {s} -> {n_lanes}")
+    if n_lanes == s:
+        return books
+    return jax.tree.map(
+        lambda a: jnp.pad(a, [(0, n_lanes - s)] + [(0, 0)] * (a.ndim - 1)),
+        books,
+    )
+
+
 def book_depth(book: BookState, side: int, max_levels: int):
     """Aggregate [price, volume] depth view, best-first — the observable
     equivalent of the reference's S:BUY/S:SALE zset + S:depth hash

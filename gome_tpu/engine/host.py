@@ -16,7 +16,7 @@ import dataclasses
 import numpy as np
 
 from ..types import Action, MatchResult, Order, OrderType, snapshot_of
-from .book import BookConfig, DeviceOp, StepOutput
+from .book import DeviceOp, StepOutput
 
 
 class Interner:
@@ -34,6 +34,10 @@ class Interner:
             self._to_id[s] = i
             self._to_str.append(s)
         return i
+
+    def get(self, s: str) -> int | None:
+        """Read-only lookup; None if never interned."""
+        return self._to_id.get(s)
 
     def lookup(self, i: int) -> str:
         return self._to_str[i]
@@ -75,29 +79,30 @@ def encode_op(
 def decode_events(
     ctx: OpContext,
     out: StepOutput,
-    config: BookConfig,
     oids: Interner,
     uids: Interner,
 ) -> list[MatchResult]:
     """StepOutput -> the MatchResult events this op produced, in the
     reference's emission order (best level first, FIFO within level —
-    exactly the device's fill-record order)."""
+    exactly the device's fill-record order).
+
+    The caller (BatchEngine._run_exact) escalates device budgets before
+    decoding, so `out` always carries complete records; tripped budgets here
+    mean an engine bug, not an input condition."""
     order = ctx.order
     events: list[MatchResult] = []
     if order.action is Action.ADD:
         if int(out.book_overflow):
-            # The device dropped the resting remainder because the side was
-            # full (BookConfig.cap). Loud until the host spill path exists —
-            # overflow must never be silent (book.py BookConfig contract).
-            raise OverflowError(
-                f"op {order.oid}: resting insert dropped, side full "
-                f"(cap={config.cap}); host spill path required"
+            raise RuntimeError(
+                f"op {order.oid}: resting insert dropped (side full) reached "
+                "decode — cap escalation should have replayed this grid"
             )
         n = int(out.n_fills)
-        if n > config.max_fills:
-            raise OverflowError(
-                f"op {order.oid} produced {n} fills > max_fills="
-                f"{config.max_fills}; host slow path required"
+        if n > len(out.fill_qty):
+            raise RuntimeError(
+                f"op {order.oid}: {n} fills > {len(out.fill_qty)} records "
+                "reached decode — fill-record escalation should have re-run "
+                "this lane"
             )
         for j in range(n):
             qty = int(out.fill_qty[j])

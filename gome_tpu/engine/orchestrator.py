@@ -1,0 +1,100 @@
+"""The engine facade: pre-pool admission + batched device matching.
+
+This is the TPU framework's equivalent of the reference's `engine` package
+surface — the layer the gateway and the order consumer talk to
+(gomengine/engine/engine.go:35-54 + the pre-pool protocol,
+gomengine/engine/nodepool.go:14-28, gomengine/main.go:44-45):
+
+  gateway side   mark(order)      — HSET S:comparison S:U:O 1 (main.go:44-45)
+  consumer side  process(orders)  — the consumer loop body (engine.go:46-54):
+                   ADD: consumed only if still marked, else dropped
+                        (engine.go:58-62; the cancel-before-consume race,
+                        SURVEY §2.3.3)
+                   DEL: clears the mark first so a still-queued ADD dies
+                        (engine.go:88-90), then cancels on the book
+
+The pre-pool is shared state between gateway and consumer (Redis in the
+reference); here it is an in-process set — single-binary deployments share
+the MatchEngine instance. Deployments that need the race semantics to
+survive restart snapshot `pre_pool` alongside the books via the durability
+layer (gome_tpu.persist).
+"""
+
+from __future__ import annotations
+
+from ..types import Action, MatchResult, Order
+from .batch import BatchEngine, EngineStats
+from .book import BookConfig
+
+
+class MatchEngine:
+    """Admission + matching for one engine shard (a set of symbol lanes).
+
+    Orders enter twice, like the reference's two process hops: `mark()` when
+    the gateway accepts an ADD (before it is queued), `process()` when the
+    consumer drains a micro-batch from the queue. Cancels are never marked
+    (main.go:54-64 sets no pre-pool entry).
+    """
+
+    def __init__(
+        self,
+        config: BookConfig | None = None,
+        n_slots: int = 1024,
+        max_t: int = 32,
+        auto_grow: bool = True,
+    ):
+        self.batch = BatchEngine(
+            config or BookConfig(), n_slots, max_t=max_t, auto_grow=auto_grow
+        )
+        self.pre_pool: set[tuple[str, str, str]] = set()
+
+    # -- gateway side ------------------------------------------------------
+    def mark(self, order: Order) -> None:
+        """Record "submitted, not yet consumed/cancelled" for an ADD
+        (nodepool.go:14-16). No-op for other actions."""
+        if order.action is Action.ADD:
+            self.pre_pool.add(self._prekey(order))
+
+    # -- consumer side -----------------------------------------------------
+    def process(self, orders: list[Order]) -> list[MatchResult]:
+        """Apply one micro-batch in arrival order; returns the MatchResult
+        event stream in the reference's global emission order."""
+        admitted: list[Order] = []
+        for order in orders:
+            if order.action is Action.ADD:
+                key = self._prekey(order)
+                if key not in self.pre_pool:
+                    # Cancelled (or never marked) before consumption: drop
+                    # without touching the book (engine.go:58-62).
+                    self.stats.dropped_no_prepool += 1
+                    continue
+                self.pre_pool.discard(key)
+                admitted.append(order)
+            elif order.action is Action.DEL:
+                self.pre_pool.discard(self._prekey(order))
+                admitted.append(order)
+            # NOP padding never reaches the device.
+        return self.batch.process(admitted)
+
+    def process_one(self, order: Order) -> list[MatchResult]:
+        return self.process([order])
+
+    # -- views -------------------------------------------------------------
+    @property
+    def stats(self) -> EngineStats:
+        """Single source of truth: the BatchEngine's counters (the facade
+        adds only dropped_no_prepool to the same object)."""
+        return self.batch.stats
+
+    @property
+    def config(self) -> BookConfig:
+        return self.batch.config
+
+    @property
+    def books(self):
+        return self.batch.books
+
+    @staticmethod
+    def _prekey(order: Order) -> tuple[str, str, str]:
+        """S:comparison field = S:U:O (ordernode.go:89-92)."""
+        return (order.symbol, order.uuid, order.oid)

@@ -103,14 +103,13 @@ class MatchResult:
 
 @dataclass
 class StepStats:
-    """Per-operation diagnostics from the engine (new instrumentation; the
-    reference has none — SURVEY §5.5)."""
+    """Oracle-side diagnostics (new instrumentation; the reference has none —
+    SURVEY §5.5). The device engine's counters live in
+    gome_tpu.engine.batch.EngineStats."""
 
     dropped_no_prepool: int = 0
     cancels_missed: int = 0
     fills: int = 0
-    fill_overflow: int = 0  # fills beyond the fixed K record budget
-    book_overflow: int = 0  # resting inserts dropped because the side was full
 
 
 def snapshot_of(order: Order, volume: int | None = None) -> OrderSnapshot:

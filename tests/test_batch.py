@@ -35,7 +35,7 @@ def run_parity(orders, n_slots=16, max_t=8, config=CFG, chunk=50):
         lane_state = jax.tree.map(lambda a: a[lane], engine.books)
         for side in (Side.BUY, Side.SALE):
             prices, volumes, n = jax.device_get(
-                book_depth(lane_state, int(side), config.cap)
+                book_depth(lane_state, int(side), engine.config.cap)
             )
             got_depth = [(int(prices[i]), int(volumes[i])) for i in range(int(n))]
             assert got_depth == book.depth(side), f"{symbol}/{side} depth"
@@ -80,8 +80,8 @@ def test_single_symbol_batch_matches_sequential():
     run_parity(orders, n_slots=2, max_t=8, chunk=64)
 
 
-def test_lane_overflow_error():
-    engine = BatchEngine(CFG, n_slots=2, max_t=4)
+def test_lane_overflow_error_when_growth_disabled():
+    engine = BatchEngine(CFG, n_slots=2, max_t=4, auto_grow=False)
     orders = [
         Order(
             uuid="u", oid=str(i), symbol=f"s{i}", side=Side.BUY,
@@ -91,6 +91,34 @@ def test_lane_overflow_error():
     ]
     with pytest.raises(ValueError, match="n_slots"):
         engine.process(orders)
+
+
+def test_lane_auto_growth():
+    """More distinct symbols than provisioned lanes: the engine grows the
+    stacked book and stays exact (the reference has no lane limit because
+    Redis keys are dynamic)."""
+    engine = BatchEngine(CFG, n_slots=2, max_t=4)
+    oracle = OracleEngine()
+    orders = []
+    for i in range(6):
+        orders.append(
+            Order(
+                uuid="u", oid=f"a{i}", symbol=f"s{i}", side=Side.SALE,
+                price=scale(1.0), volume=scale(0.5),
+            )
+        )
+        orders.append(
+            Order(
+                uuid="u", oid=f"b{i}", symbol=f"s{i}", side=Side.BUY,
+                price=scale(1.0), volume=scale(0.5),
+            )
+        )
+    expected = []
+    for o in orders:
+        expected.extend(oracle.process(o))
+    got = engine.process(orders)
+    assert got == expected
+    assert engine.n_slots >= 6 and engine.stats.lane_growths >= 1
 
 
 def test_max_t_spill_preserves_fifo():
@@ -133,13 +161,13 @@ def test_int32_book_mode():
     assert engine.books.price.dtype == jnp.int32
 
 
-def test_batch_overflow_collects_other_events():
-    """One op overflowing max_fills must not destroy the rest of the batch's
-    event stream (BatchOverflowError carries it)."""
-    from gome_tpu.engine.batch import BatchOverflowError
-
+def test_fill_record_overflow_escalates_exactly():
+    """An op crossing more resting orders than max_fills must still decode a
+    complete event stream: the engine re-runs that lane with a larger record
+    budget from the pre-batch snapshot (batch.py _run_exact phase 2)."""
     cfg = BookConfig(cap=32, max_fills=2)
     engine = BatchEngine(cfg, n_slots=4, max_t=8)
+    oracle = OracleEngine()
 
     def o(oid, sym, side, p, v):
         return Order(
@@ -154,13 +182,60 @@ def test_batch_overflow_collects_other_events():
         o(3, "a", Side.SALE, 1.00, 0.1),
         o(4, "a", Side.SALE, 1.00, 0.1),
         o(5, "a", Side.BUY, 1.00, 0.4),
-        # lane "b": a clean single fill that must survive
+        # lane "b": a clean single fill in the same grid
         o(6, "b", Side.SALE, 2.00, 0.5),
         o(7, "b", Side.BUY, 2.00, 0.5),
     ]
-    with pytest.raises(BatchOverflowError) as exc_info:
-        engine.process(orders)
-    err = exc_info.value
-    assert len(err.failures) == 1 and err.failures[0][0].oid == "5"
-    b_fills = [ev for ev in err.events if ev.node.symbol == "b"]
-    assert len(b_fills) == 1 and b_fills[0].match_volume == scale(0.5)
+    expected = []
+    for order in orders:
+        expected.extend(oracle.process(order))
+    got = engine.process(orders)
+    assert got == expected
+    assert engine.stats.fill_record_escalations == 1
+    a_fills = [ev for ev in got if ev.node.symbol == "a" and not ev.is_cancel]
+    assert len(a_fills) == 4
+
+
+def test_book_capacity_overflow_grows_and_stays_exact():
+    """Resting more orders than cap must grow the book, not drop inserts
+    (batch.py _run_exact phase 1); depth afterwards matches the oracle."""
+    cfg = BookConfig(cap=4, max_fills=4)
+    orders = [
+        Order(
+            uuid="u", oid=str(i), symbol="s", side=Side.SALE,
+            price=scale(1.0 + i / 100), volume=scale(1.0),
+        )
+        for i in range(10)
+    ]
+    engine, oracle = run_parity(orders, n_slots=2, max_t=4, config=cfg)
+    assert engine.config.cap >= 10
+    assert engine.stats.cap_escalations >= 1
+
+
+def test_prepool_race_drops_unmarked_add():
+    """MatchEngine facade: an ADD whose pre-pool mark was cleared by an
+    earlier-processed DEL must be dropped (engine.go:58-62, SURVEY §2.3.3)."""
+    from gome_tpu.engine import MatchEngine
+
+    engine = MatchEngine(CFG, n_slots=2, max_t=4)
+    add = Order(
+        uuid="u", oid="1", symbol="s", side=Side.SALE,
+        price=scale(1.0), volume=scale(1.0),
+    )
+    delete = Order(
+        uuid="u", oid="1", symbol="s", side=Side.SALE,
+        price=scale(1.0), volume=scale(1.0), action=Action.DEL,
+    )
+    engine.mark(add)
+    # DEL consumed first clears the mark; cancel misses (nothing resting).
+    assert engine.process([delete]) == []
+    # The queued ADD is now consumed: dropped, no book mutation.
+    assert engine.process([add]) == []
+    assert engine.stats.dropped_no_prepool == 1
+    assert engine.stats.cancels_missed == 1
+    # A correctly marked ADD still rests and can be cancelled with an event.
+    engine.mark(add)
+    assert engine.process([add]) == []
+    events = engine.process([delete])
+    assert len(events) == 1 and events[0].is_cancel
+    assert events[0].node.volume == scale(1.0)

@@ -34,7 +34,7 @@ class SingleSymbolHarness:
         op = encode_op(order, self.oids, self.uids)
         self.book, out = self._step(self.book, op)
         evs = decode_events(
-            OpContext(order), jax.device_get(out), self.config, self.oids, self.uids
+            OpContext(order), jax.device_get(out), self.oids, self.uids
         )
         self.events.extend(evs)
         return evs
