@@ -1,0 +1,24 @@
+"""Wire contract package: order.proto (parity with the reference's
+api/order.proto:1-29 + extensions), generated message classes, and the
+hand-wired gRPC service plumbing (this environment has protoc but no
+grpc_python_plugin, so service registration/stubs live in service.py)."""
+
+from . import order_pb2
+from .service import OrderStub, add_order_servicer
+
+OrderRequest = order_pb2.OrderRequest
+OrderResponse = order_pb2.OrderResponse
+MatchEvent = order_pb2.MatchEvent
+OrderSnapshotMsg = order_pb2.OrderSnapshot
+SubscribeRequest = order_pb2.SubscribeRequest
+
+__all__ = [
+    "order_pb2",
+    "OrderRequest",
+    "OrderResponse",
+    "MatchEvent",
+    "OrderSnapshotMsg",
+    "SubscribeRequest",
+    "OrderStub",
+    "add_order_servicer",
+]

@@ -1,0 +1,65 @@
+"""Message bus — the reference's RabbitMQ layer (gomengine/engine/rabbitmq.go)
+re-expressed as a pluggable queue abstraction.
+
+Topology parity: two named queues, inbound ``doOrder`` (orders + cancels)
+and outbound ``matchOrder`` (fill/cancel events) — rabbitmq.go:60-84 and the
+two consume loops rabbitmq.go:86-177. Backends:
+
+  memory — in-process deques; the single-binary deployment (and tests).
+  file   — durable append-only log segments with consumer offsets; unlike
+           the reference's non-durable auto-ack queues (rabbitmq.go:64,102 —
+           in-flight messages die with the process, SURVEY §2.3.6), a file
+           queue doubles as the replay log for crash recovery (§5.4).
+  amqp   — external RabbitMQ, gated on a client library being installed
+           (none is in this image; the class raises a clear error).
+
+Deliberately NOT reproduced: the reference opens a brand-new AMQP connection
+per published message (NewSimpleRabbitMQ inline at engine.go:37,112,157,174,
+193; dial at rabbitmq.go:35-38) — the documented anti-pattern. Publishers
+here hold their queue handle.
+"""
+
+from .base import Message, Queue, QueueBus
+from .codec import (
+    decode_match_result,
+    decode_order,
+    encode_match_result,
+    encode_order,
+)
+from .filelog import FileQueue
+from .memory import MemoryQueue
+
+__all__ = [
+    "Message",
+    "Queue",
+    "QueueBus",
+    "MemoryQueue",
+    "FileQueue",
+    "make_bus",
+    "encode_order",
+    "decode_order",
+    "encode_match_result",
+    "decode_match_result",
+]
+
+
+def make_bus(config) -> QueueBus:
+    """Build the two-queue bus from a BusConfig (gome_tpu.config)."""
+    if config.backend == "memory":
+        factory = lambda name: MemoryQueue(name)
+    elif config.backend == "file":
+        import os
+
+        factory = lambda name: FileQueue(name, os.path.join(config.dir, name))
+    elif config.backend == "amqp":
+        raise NotImplementedError(
+            "amqp backend requires a RabbitMQ client library (pika/amqpstorm);"
+            " none is installed in this environment. Use bus.backend=memory"
+            " or file."
+        )
+    else:  # pragma: no cover - BusConfig validates
+        raise ValueError(config.backend)
+    return QueueBus(
+        order_queue=factory(config.order_queue),
+        match_queue=factory(config.match_queue),
+    )

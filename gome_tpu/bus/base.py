@@ -1,0 +1,91 @@
+"""Queue interface shared by all bus backends.
+
+Semantics (deliberately stronger than the reference's): at-least-once
+delivery with explicit commit of consumer progress, vs the reference's
+auto-ack at-most-once (rabbitmq.go:102,148). `poll_batch` is the
+micro-batching primitive the TPU engine needs (SURVEY §7: N orders or T µs,
+whichever first) that the reference's one-message-at-a-time loop
+(rabbitmq.go:116-125) lacks.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import threading
+import time
+
+
+@dataclasses.dataclass(frozen=True)
+class Message:
+    offset: int  # monotonically increasing position in the queue
+    body: bytes
+
+
+class Queue(abc.ABC):
+    """A single named FIFO queue of byte messages."""
+
+    name: str
+
+    @abc.abstractmethod
+    def publish(self, body: bytes) -> int:
+        """Append one message; returns its offset."""
+
+    @abc.abstractmethod
+    def read_from(self, offset: int, max_n: int) -> list[Message]:
+        """Read up to max_n messages at >= offset (non-destructive)."""
+
+    @abc.abstractmethod
+    def end_offset(self) -> int:
+        """Offset one past the last published message."""
+
+    @abc.abstractmethod
+    def committed(self) -> int:
+        """The durable consumer offset (next message to process)."""
+
+    @abc.abstractmethod
+    def commit(self, offset: int) -> None:
+        """Durably record that messages below `offset` are fully processed."""
+
+    def poll_batch(
+        self, max_n: int, max_wait_s: float, poll_interval_s: float = 0.001
+    ) -> list[Message]:
+        """Micro-batch read from the committed offset: returns as soon as
+        max_n messages are available, else whatever arrived after max_wait_s
+        (possibly empty). Does NOT commit — the caller commits after the
+        batch is fully processed (crash ⇒ replay, at-least-once)."""
+        deadline = time.monotonic() + max_wait_s
+        start = self.committed()
+        while True:
+            msgs = self.read_from(start, max_n)
+            if len(msgs) >= max_n or time.monotonic() >= deadline:
+                return msgs
+            self._wait_for_publish(poll_interval_s)
+
+    def _wait_for_publish(self, timeout_s: float) -> None:
+        time.sleep(timeout_s)
+
+
+@dataclasses.dataclass
+class QueueBus:
+    """The reference's two-queue topology (rabbitmq.go: "doOrder" inbound,
+    "matchOrder" outbound)."""
+
+    order_queue: Queue
+    match_queue: Queue
+
+
+class _Waitable:
+    """Mixin: condition-variable publish notification so poll_batch wakes
+    immediately instead of sleeping the full poll interval."""
+
+    def _init_wait(self):
+        self._cond = threading.Condition()
+
+    def _notify_publish(self):
+        with self._cond:
+            self._cond.notify_all()
+
+    def _wait_for_publish(self, timeout_s: float) -> None:
+        with self._cond:
+            self._cond.wait(timeout_s)

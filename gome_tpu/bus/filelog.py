@@ -1,0 +1,124 @@
+"""Durable append-only file queue.
+
+Format: length-prefixed records in one log file per queue
+(``<dir>/<name>.log``: 4-byte big-endian length + payload per record) plus a
+sidecar ``<name>.offset`` holding the committed consumer offset as ASCII.
+Publishes fsync per append batch; commits rewrite the sidecar atomically
+(tmp + rename). A torn final record (crash mid-append) is detected on open
+and truncated away.
+
+This is the durability the reference lacks on its bus (non-durable queues +
+auto-ack, rabbitmq.go:64,102 — SURVEY §2.3.6): with a FileQueue, the order
+log doubles as the replay source for crash recovery (gome_tpu.persist), the
+role the raw Redis book plays in the reference (§5.4).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+from .base import Message, Queue, _Waitable
+
+_LEN = struct.Struct(">I")
+
+
+class FileQueue(_Waitable, Queue):
+    def __init__(self, name: str, path_base: str, fsync: bool = True):
+        self.name = name
+        self._log_path = path_base + ".log"
+        self._off_path = path_base + ".offset"
+        self._fsync = fsync
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(self._log_path) or ".", exist_ok=True)
+        # In-memory index: byte position of each record (offset -> filepos).
+        self._positions: list[int] = []
+        self._scan_existing()
+        self._f = open(self._log_path, "ab")
+        self._committed = self._read_committed()
+        self._init_wait()
+
+    # -- recovery-time scan --------------------------------------------------
+    def _scan_existing(self) -> None:
+        if not os.path.exists(self._log_path):
+            return
+        valid_end = 0
+        with open(self._log_path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + _LEN.size <= len(data):
+            (n,) = _LEN.unpack_from(data, pos)
+            if pos + _LEN.size + n > len(data):
+                break  # torn tail record
+            self._positions.append(pos)
+            pos += _LEN.size + n
+            valid_end = pos
+        if valid_end < len(data):
+            with open(self._log_path, "ab") as f:
+                f.truncate(valid_end)
+
+    def _read_committed(self) -> int:
+        try:
+            with open(self._off_path) as f:
+                return int(f.read().strip() or 0)
+        except FileNotFoundError:
+            return 0
+
+    # -- Queue interface -----------------------------------------------------
+    def publish(self, body: bytes) -> int:
+        with self._lock:
+            pos = self._f.tell()
+            self._f.write(_LEN.pack(len(body)))
+            self._f.write(body)
+            self._f.flush()
+            if self._fsync:
+                os.fsync(self._f.fileno())
+            self._positions.append(pos)
+            off = len(self._positions) - 1
+        self._notify_publish()
+        return off
+
+    def read_from(self, offset: int, max_n: int) -> list[Message]:
+        with self._lock:
+            end = min(len(self._positions), offset + max_n)
+            if offset >= end:
+                return []
+            start_pos = self._positions[offset]
+        out: list[Message] = []
+        with open(self._log_path, "rb") as f:
+            f.seek(start_pos)
+            for i in range(offset, end):
+                (n,) = _LEN.unpack(f.read(_LEN.size))
+                out.append(Message(offset=i, body=f.read(n)))
+        return out
+
+    def end_offset(self) -> int:
+        with self._lock:
+            return len(self._positions)
+
+    def committed(self) -> int:
+        with self._lock:
+            return self._committed
+
+    def commit(self, offset: int) -> None:
+        with self._lock:
+            if offset < self._committed:
+                raise ValueError(
+                    f"commit going backwards: {offset} < {self._committed}"
+                )
+            if offset > len(self._positions):
+                raise ValueError(
+                    f"commit past end: {offset} > {len(self._positions)}"
+                )
+            tmp = self._off_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(offset))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._off_path)
+            self._committed = offset
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()

@@ -1,0 +1,51 @@
+"""In-process queue backend (single-binary deployments and tests)."""
+
+from __future__ import annotations
+
+import threading
+
+from .base import Message, Queue, _Waitable
+
+
+class MemoryQueue(_Waitable, Queue):
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._items: list[bytes] = []
+        self._committed = 0
+        self._init_wait()
+
+    def publish(self, body: bytes) -> int:
+        with self._lock:
+            self._items.append(bytes(body))
+            off = len(self._items) - 1
+        self._notify_publish()
+        return off
+
+    def read_from(self, offset: int, max_n: int) -> list[Message]:
+        with self._lock:
+            end = min(len(self._items), offset + max_n)
+            return [
+                Message(offset=i, body=self._items[i])
+                for i in range(offset, end)
+            ]
+
+    def end_offset(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def committed(self) -> int:
+        with self._lock:
+            return self._committed
+
+    def commit(self, offset: int) -> None:
+        with self._lock:
+            if offset < self._committed:
+                raise ValueError(
+                    f"commit going backwards: {offset} < {self._committed}"
+                )
+            if offset > len(self._items):
+                raise ValueError(
+                    f"commit past end: {offset} > {len(self._items)}"
+                )
+            self._committed = offset

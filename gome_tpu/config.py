@@ -1,0 +1,190 @@
+"""Typed YAML configuration — the framework's equivalent of the reference's
+config system (gomengine/util/conf.go:3-30 + config.yaml.example).
+
+Reference parity: the same four YAML sections are accepted with the same keys
+(`grpc`, `redis`, `rabbitmq`, `gomengine.accuracy` — conf.go:3-30; the dead
+`mysql` block of config.yaml.example:16-21 is ignored here too). Differences,
+deliberate (SURVEY §5.6 called out every weakness we fix):
+
+  * one explicit `load_config()` call instead of four independent package
+    `init()`s reading a CWD-relative path with errors ignored
+    (engine.go:30-33, grpc/grpc.go:19-22, redis/redis.go:12-15);
+  * validation with loud errors instead of silent zero-values;
+  * new sections for what the TPU engine adds: `engine` (book geometry,
+    micro-batch shape), `bus` (queue backend selection), `persist`
+    (snapshot cadence/location). All have working defaults so a reference
+    config.yaml loads unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+import yaml
+
+from .fixed import DEFAULT_ACCURACY
+
+
+@dataclasses.dataclass(frozen=True)
+class GrpcConfig:
+    """conf.go:24-27 (GRPC{host, port})."""
+
+    host: str = "127.0.0.1"
+    port: int = 8088
+
+
+@dataclasses.dataclass(frozen=True)
+class StoreConfig:
+    """conf.go:11-15 (Cache = the Redis durability tier). In the TPU build
+    Redis is optional (snapshots can target the local filesystem instead);
+    `enabled` gates it so environments without a Redis server still run
+    (the reference hard-requires Redis because Redis IS its book)."""
+
+    host: str = "127.0.0.1"
+    port: int = 6379
+    password: str = ""
+    enabled: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class BusConfig:
+    """conf.go:17-22 (RabbitMQ) generalized: the queue topology (two named
+    queues, "doOrder" inbound / "matchOrder" outbound — rabbitmq.go:60-84)
+    is preserved; the transport is pluggable (gome_tpu.bus backends):
+      memory — in-process deques (single-binary deployments, tests)
+      file   — durable append-only log segments (crash-safe, replayable)
+      amqp   — external RabbitMQ (gated on a client lib being installed)
+    """
+
+    backend: str = "memory"
+    dir: str = "bus_data"
+    host: str = "127.0.0.1"
+    port: int = 5672
+    username: str = ""
+    password: str = ""
+    order_queue: str = "doOrder"  # rabbitmq.go: queue names
+    match_queue: str = "matchOrder"
+
+    _BACKENDS = ("memory", "file", "amqp")
+
+    def __post_init__(self):
+        if self.backend not in self._BACKENDS:
+            raise ValueError(
+                f"bus.backend must be one of {self._BACKENDS}, "
+                f"got {self.backend!r}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """The reference's single semantic knob (`gomengine.accuracy`,
+    conf.go:29-30) plus the TPU engine's geometry: book capacity per side,
+    fill-record budget, provisioned symbol lanes, micro-batch depth."""
+
+    accuracy: int = DEFAULT_ACCURACY
+    cap: int = 256
+    max_fills: int = 16
+    n_slots: int = 1024
+    max_t: int = 32
+    dtype: str = "int64"  # "int32" halves HBM traffic when ranges allow
+    auto_grow: bool = True
+
+    def __post_init__(self):
+        if not 0 <= self.accuracy <= 18:
+            raise ValueError(f"accuracy must be in [0, 18], got {self.accuracy}")
+        for name in ("cap", "max_fills", "n_slots", "max_t"):
+            v = getattr(self, name)
+            if v <= 0:
+                raise ValueError(f"engine.{name} must be positive, got {v}")
+        if self.dtype not in ("int32", "int64"):
+            raise ValueError(f"engine.dtype must be int32|int64, got {self.dtype}")
+
+    def book_config(self):
+        from .engine.book import BookConfig
+        import jax.numpy as jnp
+
+        return BookConfig(
+            cap=self.cap,
+            max_fills=self.max_fills,
+            dtype=jnp.int32 if self.dtype == "int32" else jnp.int64,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class PersistConfig:
+    """Snapshot/recovery cadence (new — the reference needs none because
+    every Redis write is instantly durable, SURVEY §5.4)."""
+
+    dir: str = "snapshots"
+    every_n_batches: int = 64
+    keep: int = 4
+
+    def __post_init__(self):
+        if self.every_n_batches <= 0 or self.keep <= 0:
+            raise ValueError("persist cadence/keep must be positive")
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    grpc: GrpcConfig = GrpcConfig()
+    store: StoreConfig = StoreConfig()
+    bus: BusConfig = BusConfig()
+    engine: EngineConfig = EngineConfig()
+    persist: PersistConfig = PersistConfig()
+
+
+def _build(cls, raw: dict[str, Any], section: str):
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for key, value in raw.items():
+        if key not in fields:
+            raise ValueError(f"unknown key {section}.{key}")
+        ftype = fields[key].type
+        # YAML strings for numeric fields (the reference's conf.go keeps
+        # ports as strings) are coerced here.
+        if ftype in (int, "int") and isinstance(value, str):
+            value = int(value)
+        kwargs[key] = value
+    return cls(**kwargs)
+
+
+def load_config(path: str | None = None) -> Config:
+    """Load config from a YAML file; missing file ⇒ all defaults (unlike the
+    reference, which silently zeroes every field on a missing config.yaml).
+    Reference-shaped files load unchanged: `redis`/`rabbitmq` sections map to
+    store/bus, `gomengine.accuracy` to engine.accuracy."""
+    raw: dict[str, Any] = {}
+    if path is not None:
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+    elif os.path.exists("config.yaml"):
+        with open("config.yaml") as f:
+            raw = yaml.safe_load(f) or {}
+
+    grpc_raw = raw.get("grpc", {}) or {}
+    store_raw = dict(raw.get("redis", {}) or {})
+    if store_raw:
+        store_raw.setdefault("enabled", True)
+    bus_raw = dict(raw.get("rabbitmq", {}) or {})
+    if bus_raw:
+        bus_raw.setdefault("backend", "amqp")
+    bus_raw.update(raw.get("bus", {}) or {})
+    engine_raw = dict(raw.get("gomengine", {}) or {})
+    engine_raw.update(raw.get("engine", {}) or {})
+    persist_raw = raw.get("persist", {}) or {}
+    raw.pop("mysql", None)  # dead section, config.yaml.example:16-21
+
+    known = {"grpc", "redis", "rabbitmq", "bus", "gomengine", "engine", "persist"}
+    unknown = set(raw) - known
+    if unknown:
+        raise ValueError(f"unknown config sections: {sorted(unknown)}")
+
+    return Config(
+        grpc=_build(GrpcConfig, grpc_raw, "grpc"),
+        store=_build(StoreConfig, store_raw, "redis"),
+        bus=_build(BusConfig, bus_raw, "bus"),
+        engine=_build(EngineConfig, engine_raw, "engine"),
+        persist=_build(PersistConfig, persist_raw, "engine"),
+    )

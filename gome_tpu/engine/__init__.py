@@ -1,4 +1,4 @@
-from .batch import BatchEngine, EngineStats, batch_step
+from .batch import BatchEngine, CapacityError, EngineStats, batch_step
 from .book import BookConfig, BookState, DeviceOp, StepOutput, init_book, init_books
 from .orchestrator import MatchEngine
 from .step import step, step_impl

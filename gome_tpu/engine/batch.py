@@ -46,7 +46,7 @@ from .book import (
     init_books,
 )
 from .host import Interner, OpContext, decode_events, encode_op
-from .step import step_impl
+from .step import ACTION_ADD, step_impl
 
 
 def _lane_scan_impl(config: BookConfig, book: BookState, ops_lane: DeviceOp):
@@ -81,6 +81,12 @@ def _next_pow2(n: int) -> int:
     while p < n:
         p *= 2
     return p
+
+
+class CapacityError(RuntimeError):
+    """A configured growth ceiling (max_slots / max_cap) was hit. The book
+    state is unchanged for the op that tripped it; callers may shed load or
+    re-shard rather than exhaust device memory."""
 
 
 @dataclasses.dataclass
@@ -118,11 +124,23 @@ class BatchEngine:
         n_slots: int,
         max_t: int = 32,
         auto_grow: bool = True,
+        max_slots: int = 1 << 16,
+        max_cap: int = 1 << 14,
     ):
+        """max_slots / max_cap bound auto-grow (symbol lanes / per-side book
+        capacity). Growth past a ceiling raises CapacityError instead of
+        exhausting HBM — explicit backpressure the caller can surface
+        (the reference has no such ceiling because Redis pages to disk)."""
+        if config.cap > max_cap:
+            raise ValueError(f"cap {config.cap} exceeds max_cap {max_cap}")
+        if n_slots > max_slots:
+            raise ValueError(f"n_slots {n_slots} exceeds max_slots {max_slots}")
         self.config = config
         self.n_slots = n_slots
         self.max_t = max_t
         self.auto_grow = auto_grow
+        self.max_slots = max_slots
+        self.max_cap = max_cap
         self.books = init_books(config, n_slots)
         self.symbols = Interner()  # symbol -> lane id + 1 offset handled below
         self.oids = Interner()
@@ -133,11 +151,17 @@ class BatchEngine:
         lane = self.symbols.intern(symbol) - 1  # Interner ids start at 1
         if lane >= self.n_slots:
             if not self.auto_grow:
-                raise ValueError(
+                raise CapacityError(
                     f"symbol {symbol!r} needs lane {lane} but engine has "
                     f"n_slots={self.n_slots} (auto_grow disabled)"
                 )
-            new_slots = max(self.n_slots * 2, lane + 1)
+            new_slots = min(max(self.n_slots * 2, lane + 1), self.max_slots)
+            if lane >= new_slots:
+                raise CapacityError(
+                    f"symbol {symbol!r} needs lane {lane} but max_slots="
+                    f"{self.max_slots}; raise max_slots or shard symbols "
+                    "across more engines"
+                )
             self.books = grow_lanes(self.books, new_slots)
             self.n_slots = new_slots
             self.stats.lane_growths += 1
@@ -163,19 +187,22 @@ class BatchEngine:
         return events
 
     def _one_grid(self, pending, decoded):
+        # Resolve lanes first (this may auto-grow the book stack), so the
+        # grid is allocated once at the final lane count and newly created
+        # lanes pack into THIS grid rather than deferring to an extra
+        # device call.
+        lanes = [self._lane(order.symbol) for _, order in pending]
         grid = _nop_grid(self.config, self.n_slots, self.max_t)
         contexts: dict[tuple[int, int], tuple[int, Order]] = {}
         fill_level: dict[int, int] = {}
         leftover: list[tuple[int, Order]] = []
         blocked: set[int] = set()  # lanes whose FIFO order must not be broken
 
-        for arrival, order in pending:
-            lane = self._lane(order.symbol)
-            if lane >= grid["action"].shape[0]:
-                # Lane created by auto-grow mid-packing; defer to next grid.
-                blocked.add(lane)
+        for (arrival, order), lane in zip(pending, lanes):
             t = fill_level.get(lane, 0)
             if lane in blocked or t >= self.max_t:
+                # Lane's time axis is full: defer, and block the lane so
+                # same-symbol ops never reorder (SURVEY §5.2).
                 blocked.add(lane)
                 leftover.append((arrival, order))
                 continue
@@ -185,13 +212,6 @@ class BatchEngine:
             contexts[(lane, t)] = (arrival, order)
             fill_level[lane] = t + 1
 
-        if self.n_slots > grid["action"].shape[0]:
-            # Lanes were auto-grown while packing; pad the grid with NOP rows
-            # so ops and the (already-grown) book stack agree on S.
-            extra = self.n_slots - grid["action"].shape[0]
-            grid = {
-                k: np.pad(v, [(0, extra), (0, 0)]) for k, v in grid.items()
-            }
         ops = DeviceOp(**{k: v for k, v in grid.items()})
         outs, lane_overrides = self._run_exact(ops, contexts)
         for (lane, t), (arrival, order) in contexts.items():
@@ -232,9 +252,17 @@ class BatchEngine:
                 break
             self.stats.cap_escalations += 1
             counts = np.asarray(jax.device_get(books_before.count))  # [S, 2]
-            adds_per_lane = np.sum(np.asarray(ops.action) == 1, axis=1)  # [S]
+            adds_per_lane = np.sum(
+                np.asarray(ops.action) == ACTION_ADD, axis=1
+            )  # [S]
             bound = int((counts.max(axis=1) + adds_per_lane).max())
             new_cap = _next_pow2(max(bound, self.config.cap + 1))
+            if new_cap > self.max_cap:
+                raise CapacityError(
+                    f"book cap escalation to {new_cap} exceeds max_cap="
+                    f"{self.max_cap} (a side is holding >{self.config.cap} "
+                    "resting orders); raise max_cap or shed load"
+                )
             books_before = grow_books(books_before, new_cap)
             self.config = dataclasses.replace(self.config, cap=new_cap)
         self.books = new_books

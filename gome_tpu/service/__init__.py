@@ -1,0 +1,17 @@
+"""Service layer: the gRPC gateway, the order consumer, and the match-event
+feed — the reference's three processes (gomengine/main.go,
+consume_new_order.go, consume_match_order.go) as composable components that
+run in one binary (default) or separately against a shared `file` bus."""
+
+from .gateway import OrderGateway, serve_gateway
+from .consumer import OrderConsumer
+from .matchfeed import MatchFeed
+from .app import EngineService
+
+__all__ = [
+    "OrderGateway",
+    "serve_gateway",
+    "OrderConsumer",
+    "MatchFeed",
+    "EngineService",
+]

@@ -1,0 +1,107 @@
+"""EngineService — the whole stack assembled from one Config.
+
+The reference runs three processes wired by external RabbitMQ/Redis
+(README.md run instructions; SURVEY §1): gRPC server, order consumer, match
+consumer. Here the default deployment is one binary hosting all three
+components around the in-process (or file) bus; the same components can be
+run in separate processes against a shared `file` bus directory — the
+pre-pool race semantics then require the gateway and consumer to share the
+engine process (gateway in the consumer binary) or an external marker store,
+which is exactly the trade the reference makes by putting the pre-pool in
+Redis (nodepool.go:14-28).
+"""
+
+from __future__ import annotations
+
+from ..bus import make_bus
+from ..config import Config
+from ..engine.orchestrator import MatchEngine
+from ..utils.logging import configure as configure_logging, get_logger
+from .consumer import OrderConsumer
+from .gateway import OrderGateway, serve_gateway
+from .matchfeed import MatchFeed
+
+log = get_logger("app")
+
+
+class EngineService:
+    def __init__(self, config: Config | None = None, persist=None):
+        self.config = config or Config()
+        configure_logging()
+        self.bus = make_bus(self.config.bus)
+        e = self.config.engine
+        self.engine = MatchEngine(
+            config=e.book_config(),
+            n_slots=e.n_slots,
+            max_t=e.max_t,
+            auto_grow=e.auto_grow,
+        )
+        self.persist = persist  # gome_tpu.persist.Persister or None
+        on_batch = None
+        if persist is not None:
+            persist.attach(self.engine, self.bus)
+            on_batch = persist.on_batch
+        self.feed = MatchFeed(self.bus)
+        self.consumer = OrderConsumer(
+            self.engine,
+            self.bus,
+            batch_n=e.max_t * max(1, e.n_slots // 8),
+            on_batch=on_batch,
+        )
+        self.gateway = OrderGateway(
+            self.bus,
+            accuracy=e.accuracy,
+            mark=self.engine.mark,
+            match_feed=self.feed,
+        )
+        self._server = None
+
+    def start(self):
+        """Start gRPC server + consumer + feed threads; returns self."""
+        if self.persist is not None:
+            self.persist.restore_latest()
+        self._server = serve_gateway(self.gateway, self.config)
+        self.consumer.start()
+        self.feed.start()
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.stop(grace=2).wait()
+            self._server = None
+        self.consumer.stop()
+        self.feed.stop()
+
+    def wait(self):
+        if self._server is not None:
+            self._server.wait_for_termination()
+
+    # -- synchronous conveniences (tests, embedded use) ----------------------
+    def pump(self) -> int:
+        """Drain order queue then match queue once, synchronously (no
+        threads). Returns orders processed."""
+        n = self.consumer.drain()
+        self.feed.drain()
+        return n
+
+
+def main(argv=None):
+    """CLI entry: `python -m gome_tpu.service.app [config.yaml]` — the
+    single-binary replacement for the reference's three `go run` processes
+    (README.md:11-15)."""
+    import sys
+
+    from ..config import load_config
+
+    argv = sys.argv[1:] if argv is None else argv
+    config = load_config(argv[0] if argv else None)
+    svc = EngineService(config).start()
+    log.info("engine service up (grpc %s:%d)", config.grpc.host, config.grpc.port)
+    try:
+        svc.wait()
+    except KeyboardInterrupt:
+        svc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Order consumer — the reference's consume_new_order process
+(consume_new_order.go:7-10 → rabbitmq.go:86-130) with the micro-batching the
+TPU engine needs.
+
+The reference drains one message at a time and runs the full match path per
+order (rabbitmq.go:116-125). Here the loop polls a micro-batch (N orders or
+T µs, whichever first — SURVEY §7 hard part (e)), feeds it to the batched
+device engine in arrival order (same-symbol order preserved by lane packing,
+batch.py), publishes every resulting MatchResult to the "matchOrder" queue
+(engine.go:154-158's role), and only then commits the consumed offset —
+at-least-once where the reference is at-most-once (auto-ack,
+rabbitmq.go:102; SURVEY §2.3.6).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..bus import QueueBus, decode_order, encode_match_result
+from ..engine.orchestrator import MatchEngine
+from ..utils.logging import get_logger
+
+log = get_logger("consumer")
+
+
+class OrderConsumer:
+    def __init__(
+        self,
+        engine: MatchEngine,
+        bus: QueueBus,
+        batch_n: int = 256,
+        batch_wait_s: float = 0.002,
+        on_batch=None,
+    ):
+        self.engine = engine
+        self.bus = bus
+        self.batch_n = batch_n
+        self.batch_wait_s = batch_wait_s
+        self.on_batch = on_batch  # callback(n_orders, n_events): persist hook
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def run_once(self) -> int:
+        """Drain one micro-batch; returns the number of orders processed."""
+        msgs = self.bus.order_queue.poll_batch(self.batch_n, self.batch_wait_s)
+        if not msgs:
+            return 0
+        orders = [decode_order(m.body) for m in msgs]
+        events = self.engine.process(orders)
+        for ev in events:
+            self.bus.match_queue.publish(encode_match_result(ev))
+        # Commit only after results are published: a crash between processing
+        # and commit replays the batch (at-least-once; recovery dedup lives
+        # in gome_tpu.persist's replay logic).
+        self.bus.order_queue.commit(msgs[-1].offset + 1)
+        if self.on_batch is not None:
+            self.on_batch(len(orders), len(events))
+        return len(orders)
+
+    def drain(self) -> int:
+        """Process until the order queue is empty (tests, recovery replay)."""
+        total = 0
+        while self.bus.order_queue.committed() < self.bus.order_queue.end_offset():
+            total += self.run_once()
+        return total
+
+    # -- background loop -----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("consumer already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="order-consumer", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:  # keep consuming; reference panics instead
+                log.exception("order batch failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

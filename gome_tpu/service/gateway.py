@@ -1,0 +1,109 @@
+"""gRPC gateway — the reference's server process (gomengine/main.go:22-64).
+
+Handler behavior parity (main.go:39-64): handlers do NO matching — they
+build the internal order, mark the pre-pool (ADD only; main.go:44-45 — DEL
+never marks), publish to the "doOrder" queue, and return success
+immediately. The response never reflects matching outcome; the pipeline is
+fully asynchronous (SURVEY §1 L4).
+
+Differences, deliberate:
+  * float→tick scaling is validated here at the edge (the reference scales
+    inside the consumer, ordernode.go:76-87, and cannot reject bad input —
+    its gateway already returned success);
+  * SubscribeMatches streams the matchOrder feed over gRPC (extension; the
+    reference's downstream is an AMQP stub, rabbitmq.go:169).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+
+import grpc
+
+from ..api import order_pb2 as pb
+from ..api.service import add_order_servicer
+from ..bus import QueueBus, encode_order
+from ..config import Config
+from ..fixed import scale
+from ..types import Action, Order, OrderType, Side
+from ..utils.logging import get_logger
+
+log = get_logger("gateway")
+
+
+def order_from_request(
+    request: pb.OrderRequest, action: Action, accuracy: int
+) -> Order:
+    """OrderRequest → internal Order (NewOrderNode's role,
+    ordernode.go:38-54: stamp action, scale price/volume by 10^accuracy)."""
+    return Order(
+        uuid=request.uuid,
+        oid=request.oid,
+        symbol=request.symbol,
+        side=Side(request.transaction),
+        price=scale(request.price, accuracy),
+        volume=scale(request.volume, accuracy),
+        action=action,
+        order_type=OrderType(request.kind),
+    )
+
+
+class OrderGateway:
+    """The Order servicer (main.go:20,39-64)."""
+
+    def __init__(self, bus: QueueBus, accuracy: int, mark=None, match_feed=None):
+        """mark: callable(Order) recording the pre-pool entry — the
+        MatchEngine.mark bound method in single-binary mode. match_feed:
+        MatchFeed for SubscribeMatches (optional)."""
+        self._bus = bus
+        self._accuracy = accuracy
+        self._mark = mark or (lambda order: None)
+        self._match_feed = match_feed
+
+    def DoOrder(self, request: pb.OrderRequest, context) -> pb.OrderResponse:
+        try:
+            order = order_from_request(request, Action.ADD, self._accuracy)
+            if order.volume <= 0:
+                raise ValueError("volume must be positive")
+            if order.order_type is OrderType.LIMIT and order.price <= 0:
+                raise ValueError("limit price must be positive")
+        except ValueError as e:
+            return pb.OrderResponse(code=3, message=f"rejected: {e}")
+        self._mark(order)  # pre-pool before queueing (main.go:44-45)
+        self._bus.order_queue.publish(encode_order(order))
+        # main.go:49: unconditional success; matching outcome arrives async.
+        return pb.OrderResponse(code=0, message="order accepted")
+
+    def DeleteOrder(self, request: pb.OrderRequest, context) -> pb.OrderResponse:
+        try:
+            order = order_from_request(request, Action.DEL, self._accuracy)
+        except ValueError as e:
+            return pb.OrderResponse(code=3, message=f"rejected: {e}")
+        # No pre-pool mark (main.go:54-64); the consumer clears it so a
+        # still-queued ADD dies (engine.go:88-90, SURVEY §2.3.3).
+        self._bus.order_queue.publish(encode_order(order))
+        return pb.OrderResponse(code=0, message="cancel accepted")
+
+    def SubscribeMatches(self, request: pb.SubscribeRequest, context):
+        if self._match_feed is None:
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED, "no match feed attached"
+            )
+        yield from self._match_feed.subscribe(context)
+
+
+def serve_gateway(
+    gateway: OrderGateway, config: Config, max_workers: int = 16
+) -> grpc.Server:
+    """Build + start the gRPC server (main.go:28-36 / grpc.go:24-39's
+    listener-from-config). Returns the started server; caller owns
+    shutdown."""
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    add_order_servicer(server, gateway)
+    addr = f"{config.grpc.host}:{config.grpc.port}"
+    bound = server.add_insecure_port(addr)
+    if bound == 0:
+        raise RuntimeError(f"failed to bind gRPC listener on {addr}")
+    server.start()
+    log.info("gateway serving on %s:%d", config.grpc.host, bound)
+    return server

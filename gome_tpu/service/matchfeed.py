@@ -1,0 +1,123 @@
+"""Match-event feed — the reference's consume_match_order process
+(consume_match_order.go:7-10 → rabbitmq.go:132-177): drains the
+"matchOrder" queue, logs each MatchResult (rabbitmq.go:162-171), and — where
+the reference leaves a "your code..." stub (rabbitmq.go:169) — fans events
+out to in-process subscribers (the gateway's SubscribeMatches stream).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from ..api import order_pb2 as pb
+from ..bus import QueueBus, decode_match_result
+from ..fixed import unscale
+from ..types import MatchResult, OrderSnapshot
+from ..utils.logging import get_logger
+
+log = get_logger("matchfeed")
+
+
+def snapshot_to_pb(s: OrderSnapshot) -> pb.OrderSnapshot:
+    # Wire doubles carry the reference's observable values: the scaled
+    # float64 (SURVEY §2.2 — events serialize post-scaling nodes).
+    return pb.OrderSnapshot(
+        uuid=s.uuid,
+        oid=s.oid,
+        symbol=s.symbol,
+        transaction=int(s.side),
+        price=unscale(s.price),
+        volume=unscale(s.volume),
+    )
+
+
+def match_result_to_pb(mr: MatchResult) -> pb.MatchEvent:
+    return pb.MatchEvent(
+        node=snapshot_to_pb(mr.node),
+        match_node=snapshot_to_pb(mr.match_node),
+        match_volume=float(mr.match_volume),
+    )
+
+
+class MatchFeed:
+    def __init__(self, bus: QueueBus, log_events: bool = True):
+        self.bus = bus
+        self.log_events = log_events
+        self._subs: list[queue.Queue] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.events_seen = 0
+
+    def run_once(self) -> int:
+        msgs = self.bus.match_queue.poll_batch(256, 0.002)
+        if not msgs:
+            return 0
+        with self._lock:
+            subs = list(self._subs)
+        for m in msgs:
+            mr = decode_match_result(m.body)
+            self.events_seen += 1
+            if self.log_events:
+                # rabbitmq.go:170's util.Info.Printf of the result
+                log.info(
+                    "match %s: taker=%s maker=%s qty=%d",
+                    "CANCEL" if mr.is_cancel else "FILL",
+                    mr.node.oid,
+                    mr.match_node.oid,
+                    mr.match_volume,
+                )
+            ev = match_result_to_pb(mr)
+            for q in subs:
+                q.put(ev)
+        self.bus.match_queue.commit(msgs[-1].offset + 1)
+        return len(msgs)
+
+    def drain(self) -> int:
+        total = 0
+        while self.bus.match_queue.committed() < self.bus.match_queue.end_offset():
+            total += self.run_once()
+        return total
+
+    def subscribe(self, context=None):
+        """Generator of pb.MatchEvent for one subscriber (gateway streaming
+        handler). Ends when the gRPC context goes inactive or the feed
+        stops."""
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            self._subs.append(q)
+        try:
+            while not self._stop.is_set():
+                if context is not None and not context.is_active():
+                    return
+                try:
+                    yield q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+        finally:
+            with self._lock:
+                self._subs.remove(q)
+
+    # -- background loop -----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("feed already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="match-feed", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:
+                log.exception("match feed batch failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -1,0 +1,38 @@
+"""Logging — the reference's util/logger.go:9-23 re-expressed on stdlib
+logging: `Info`/`Error` writers multi-targeting order.log + stderr, plus
+structured extras the reference lacks (level filtering, per-module names).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_CONFIGURED = False
+LOG_FILE = "order.log"  # logger.go:14 — same default file name
+
+
+def configure(log_file: str | None = LOG_FILE, level: int = logging.INFO) -> None:
+    """Idempotent root setup: file + stderr handlers (logger.go:17-22's
+    io.MultiWriter). Call once at process start; get_logger works either
+    way (falls back to stderr-only if never configured)."""
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    root = logging.getLogger("gome_tpu")
+    root.setLevel(level)
+    fmt = logging.Formatter(
+        "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+    )
+    stderr = logging.StreamHandler(sys.stderr)
+    stderr.setFormatter(fmt)
+    root.addHandler(stderr)
+    if log_file:
+        fh = logging.FileHandler(log_file)
+        fh.setFormatter(fmt)
+        root.addHandler(fh)
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"gome_tpu.{name}")

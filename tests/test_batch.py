@@ -7,7 +7,7 @@ per-symbol invariant)."""
 
 import pytest
 
-from gome_tpu.engine import BatchEngine, BookConfig
+from gome_tpu.engine import BatchEngine, BookConfig, CapacityError
 from gome_tpu.fixed import scale
 from gome_tpu.oracle import OracleEngine
 from gome_tpu.types import Action, Order, Side
@@ -89,8 +89,38 @@ def test_lane_overflow_error_when_growth_disabled():
         )
         for i in range(3)
     ]
-    with pytest.raises(ValueError, match="n_slots"):
+    with pytest.raises(CapacityError, match="n_slots"):
         engine.process(orders)
+
+
+def test_growth_ceilings_backpressure():
+    """max_slots / max_cap bound auto-grow with a loud CapacityError instead
+    of unbounded HBM growth (explicit backpressure)."""
+    engine = BatchEngine(CFG, n_slots=2, max_t=4, max_slots=4)
+    orders = [
+        Order(
+            uuid="u", oid=str(i), symbol=f"s{i}", side=Side.BUY,
+            price=scale(1.0), volume=scale(1.0),
+        )
+        for i in range(5)
+    ]
+    with pytest.raises(CapacityError, match="max_slots"):
+        engine.process(orders)
+
+    # cap ceiling: CFG.cap resting orders + one more on a single side
+    small = BatchEngine(CFG, n_slots=1, max_t=CFG.cap + 1, max_cap=CFG.cap)
+    orders = [
+        Order(
+            uuid="u", oid=str(i), symbol="s", side=Side.BUY,
+            price=(i + 1) * 1_000_000, volume=scale(1.0),
+        )
+        for i in range(CFG.cap + 1)
+    ]
+    with pytest.raises(CapacityError, match="max_cap"):
+        small.process(orders)
+
+    with pytest.raises(ValueError, match="max_cap"):
+        BatchEngine(CFG, n_slots=1, max_cap=CFG.cap // 2)
 
 
 def test_lane_auto_growth():

@@ -1,0 +1,146 @@
+"""Bus backends + codec tests (gome_tpu.bus vs rabbitmq.go topology)."""
+
+import threading
+import time
+
+import pytest
+
+from gome_tpu.bus import (
+    FileQueue,
+    MemoryQueue,
+    decode_match_result,
+    decode_order,
+    encode_match_result,
+    encode_order,
+    make_bus,
+)
+from gome_tpu.config import BusConfig
+from gome_tpu.types import Action, MatchResult, Order, OrderSnapshot, OrderType, Side
+
+
+@pytest.fixture(params=["memory", "file"])
+def queue(request, tmp_path):
+    if request.param == "memory":
+        return MemoryQueue("doOrder")
+    return FileQueue("doOrder", str(tmp_path / "doOrder"))
+
+
+def test_publish_read_commit(queue):
+    offs = [queue.publish(f"m{i}".encode()) for i in range(5)]
+    assert offs == [0, 1, 2, 3, 4]
+    assert queue.end_offset() == 5
+    msgs = queue.read_from(0, 3)
+    assert [m.body for m in msgs] == [b"m0", b"m1", b"m2"]
+    assert queue.committed() == 0
+    queue.commit(3)
+    assert queue.committed() == 3
+    # non-destructive reads: earlier offsets still readable
+    assert queue.read_from(1, 1)[0].body == b"m1"
+    with pytest.raises(ValueError):
+        queue.commit(2)  # backwards
+    with pytest.raises(ValueError):
+        queue.commit(99)  # past end
+
+
+def test_poll_batch_returns_early_when_full(queue):
+    for i in range(4):
+        queue.publish(f"m{i}".encode())
+    t0 = time.monotonic()
+    msgs = queue.poll_batch(4, max_wait_s=5.0)
+    assert len(msgs) == 4
+    assert time.monotonic() - t0 < 1.0  # did not wait for the deadline
+
+
+def test_poll_batch_times_out_partial(queue):
+    queue.publish(b"only")
+    msgs = queue.poll_batch(8, max_wait_s=0.05)
+    assert [m.body for m in msgs] == [b"only"]
+
+
+def test_poll_batch_wakes_on_publish(queue):
+    def later():
+        time.sleep(0.05)
+        queue.publish(b"late")
+
+    t = threading.Thread(target=later)
+    t.start()
+    msgs = queue.poll_batch(1, max_wait_s=5.0)
+    t.join()
+    assert [m.body for m in msgs] == [b"late"]
+
+
+def test_file_queue_survives_reopen(tmp_path):
+    base = str(tmp_path / "q")
+    q = FileQueue("q", base)
+    for i in range(10):
+        q.publish(f"msg-{i}".encode())
+    q.commit(4)
+    q.close()
+
+    q2 = FileQueue("q", base)
+    assert q2.end_offset() == 10
+    assert q2.committed() == 4
+    assert q2.read_from(4, 2)[0].body == b"msg-4"
+    # and it keeps appending after the existing tail
+    q2.publish(b"post-restart")
+    assert q2.read_from(10, 1)[0].body == b"post-restart"
+
+
+def test_file_queue_truncates_torn_tail(tmp_path):
+    base = str(tmp_path / "q")
+    q = FileQueue("q", base)
+    q.publish(b"whole")
+    q.close()
+    with open(base + ".log", "ab") as f:
+        f.write(b"\x00\x00\x00\xff partial")  # length says 255, body short
+    q2 = FileQueue("q", base)
+    assert q2.end_offset() == 1
+    assert q2.read_from(0, 9)[0].body == b"whole"
+
+
+def test_make_bus_topology(tmp_path):
+    bus = make_bus(BusConfig(backend="file", dir=str(tmp_path / "bus")))
+    assert bus.order_queue.name == "doOrder"  # rabbitmq.go queue names
+    assert bus.match_queue.name == "matchOrder"
+    bus.order_queue.publish(b"x")
+    assert bus.match_queue.end_offset() == 0  # independent queues
+
+
+def test_order_codec_roundtrip():
+    order = Order(
+        uuid="7",
+        oid="o123",
+        symbol="eth2usdt",
+        side=Side.SALE,
+        price=99_500_000,
+        volume=1_000_000,
+        action=Action.DEL,
+    )
+    assert decode_order(encode_order(order)) == order
+
+
+def test_order_codec_reference_shape():
+    # Go-marshalled OrderNode JSON (exported field names, extra Redis-key
+    # fields present) must decode; unknown fields ignored.
+    body = (
+        b'{"Action":1,"Uuid":"2","Oid":"11","Symbol":"eth2usdt",'
+        b'"Transaction":0,"Price":50000000,"Volume":3000000,'
+        b'"Accuracy":8,"NodeName":"eth2usdt:node:11","IsFirst":false}'
+    )
+    order = decode_order(body)
+    assert order.action is Action.ADD
+    assert order.side is Side.BUY
+    assert order.price == 50_000_000
+    assert order.order_type is OrderType.LIMIT  # absent Kind => LIMIT
+
+
+def test_match_result_codec_roundtrip():
+    snap = lambda oid, vol: OrderSnapshot(
+        uuid="u", oid=oid, symbol="s", side=Side.BUY, price=100, volume=vol
+    )
+    mr = MatchResult(node=snap("t", 0), match_node=snap("m", 5), match_volume=5)
+    rt = decode_match_result(encode_match_result(mr))
+    assert rt == mr
+    assert not rt.is_cancel
+    cancel = MatchResult(node=snap("c", 7), match_node=snap("c", 7), match_volume=0)
+    assert decode_match_result(encode_match_result(cancel)).is_cancel

@@ -1,0 +1,91 @@
+"""Config system tests (gome_tpu.config vs the reference's conf.go semantics)."""
+
+import pytest
+
+from gome_tpu.config import Config, load_config
+
+
+def test_defaults_without_file(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # no config.yaml in CWD
+    cfg = load_config()
+    assert cfg.engine.accuracy == 8  # config.yaml.example:24 default
+    assert cfg.bus.order_queue == "doOrder"
+    assert cfg.bus.match_queue == "matchOrder"
+    assert not cfg.store.enabled
+
+
+def test_reference_shaped_yaml_loads(tmp_path):
+    # The exact section/key shape of config.yaml.example:1-25 (incl. the
+    # dead mysql block and string ports, conf.go's all-string fields).
+    p = tmp_path / "config.yaml"
+    p.write_text(
+        """
+grpc:
+  host: gome
+  port: 8088
+redis:
+  host: redis
+  port: 6379
+  password: "123456"
+rabbitmq:
+  host: rabbitmq
+  port: 5672
+  username: root
+  password: "123456"
+mysql:
+  host: 127.0.0.1
+  port: 3306
+  database: dbname
+  username: root
+  password: "123456"
+gomengine:
+  accuracy: 8
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.grpc.host == "gome" and cfg.grpc.port == 8088
+    assert cfg.store.enabled and cfg.store.host == "redis"
+    assert cfg.bus.backend == "amqp" and cfg.bus.host == "rabbitmq"
+    assert cfg.engine.accuracy == 8
+
+
+def test_engine_extension_section(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text(
+        """
+engine:
+  cap: 64
+  n_slots: 16
+  dtype: int32
+bus:
+  backend: file
+  dir: /tmp/busdir
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.engine.cap == 64 and cfg.engine.dtype == "int32"
+    assert cfg.bus.backend == "file" and cfg.bus.dir == "/tmp/busdir"
+    import jax.numpy as jnp
+
+    assert cfg.engine.book_config().dtype == jnp.int32
+
+
+def test_validation_rejects_bad_values(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text("engine:\n  cap: -1\n")
+    with pytest.raises(ValueError, match="cap"):
+        load_config(str(p))
+    p.write_text("bus:\n  backend: zeromq\n")
+    with pytest.raises(ValueError, match="backend"):
+        load_config(str(p))
+    p.write_text("nosuch:\n  a: 1\n")
+    with pytest.raises(ValueError, match="unknown config sections"):
+        load_config(str(p))
+    p.write_text("grpc:\n  hostt: x\n")
+    with pytest.raises(ValueError, match="unknown key"):
+        load_config(str(p))
+
+
+def test_defaults_object():
+    cfg = Config()
+    assert cfg.engine.book_config().cap == 256
