@@ -111,13 +111,39 @@ class FileQueue(_Waitable, Queue):
                 raise ValueError(
                     f"commit past end: {offset} > {len(self._positions)}"
                 )
-            tmp = self._off_path + ".tmp"
-            with open(tmp, "w") as f:
-                f.write(str(offset))
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self._off_path)
+            self._write_offset(offset)
             self._committed = offset
+
+    def rollback(self, offset: int) -> None:
+        with self._lock:
+            if offset > self._committed:
+                raise ValueError(
+                    f"rollback going forwards: {offset} > {self._committed}"
+                )
+            self._write_offset(offset)
+            self._committed = offset
+
+    def truncate_to(self, offset: int) -> None:
+        with self._lock:
+            if offset < self._committed:
+                raise ValueError(
+                    f"cannot truncate below committed: {offset} < "
+                    f"{self._committed}"
+                )
+            if offset >= len(self._positions):
+                return
+            pos = self._positions[offset]
+            self._f.truncate(pos)
+            self._f.seek(pos)
+            del self._positions[offset:]
+
+    def _write_offset(self, offset: int) -> None:
+        tmp = self._off_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(offset))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._off_path)
 
     def close(self) -> None:
         with self._lock:

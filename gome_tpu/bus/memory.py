@@ -49,3 +49,20 @@ class MemoryQueue(_Waitable, Queue):
                     f"commit past end: {offset} > {len(self._items)}"
                 )
             self._committed = offset
+
+    def rollback(self, offset: int) -> None:
+        with self._lock:
+            if offset > self._committed:
+                raise ValueError(
+                    f"rollback going forwards: {offset} > {self._committed}"
+                )
+            self._committed = offset
+
+    def truncate_to(self, offset: int) -> None:
+        with self._lock:
+            if offset < self._committed:
+                raise ValueError(
+                    f"cannot truncate below committed: {offset} < "
+                    f"{self._committed}"
+                )
+            del self._items[offset:]

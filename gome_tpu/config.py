@@ -115,8 +115,11 @@ class EngineConfig:
 @dataclasses.dataclass(frozen=True)
 class PersistConfig:
     """Snapshot/recovery cadence (new — the reference needs none because
-    every Redis write is instantly durable, SURVEY §5.4)."""
+    every Redis write is instantly durable, SURVEY §5.4). `enabled` defaults
+    off; a `persist:` section in config.yaml switches it on (like `redis:`
+    implies store.enabled)."""
 
+    enabled: bool = False
     dir: str = "snapshots"
     every_n_batches: int = 64
     keep: int = 4
@@ -173,7 +176,9 @@ def load_config(path: str | None = None) -> Config:
     bus_raw.update(raw.get("bus", {}) or {})
     engine_raw = dict(raw.get("gomengine", {}) or {})
     engine_raw.update(raw.get("engine", {}) or {})
-    persist_raw = raw.get("persist", {}) or {}
+    persist_raw = dict(raw.get("persist", {}) or {})
+    if persist_raw:
+        persist_raw.setdefault("enabled", True)
     raw.pop("mysql", None)  # dead section, config.yaml.example:16-21
 
     known = {"grpc", "redis", "rabbitmq", "bus", "gomengine", "engine", "persist"}
@@ -186,5 +191,5 @@ def load_config(path: str | None = None) -> Config:
         store=_build(StoreConfig, store_raw, "redis"),
         bus=_build(BusConfig, bus_raw, "bus"),
         engine=_build(EngineConfig, engine_raw, "engine"),
-        persist=_build(PersistConfig, persist_raw, "engine"),
+        persist=_build(PersistConfig, persist_raw, "persist"),
     )

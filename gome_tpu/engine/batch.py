@@ -293,6 +293,43 @@ class BatchEngine:
             lane_overrides[lane] = jax.device_get(lane_out)
         return outs, lane_overrides
 
+    # -- snapshot support ----------------------------------------------------
+    def export_state(self) -> dict:
+        """Host-side copy of all mutable engine state (books + interners +
+        geometry) for the durability layer (gome_tpu.persist)."""
+        books = jax.device_get(self.books)
+        return {
+            "books": {k: np.asarray(v) for k, v in books._asdict().items()},
+            "symbols": self.symbols.to_list(),
+            "oids": self.oids.to_list(),
+            "uids": self.uids.to_list(),
+            "cap": self.config.cap,
+            "max_fills": self.config.max_fills,
+            "dtype": np.dtype(self.config.dtype).name,
+            "n_slots": self.n_slots,
+            "max_t": self.max_t,
+        }
+
+    def import_state(self, state: dict) -> None:
+        """Restore a state exported by export_state (snapshot recovery).
+        Replaces books, interners, and geometry; stats are NOT restored
+        (counters describe a process lifetime, not book state)."""
+        import jax.numpy as jnp
+
+        self.config = dataclasses.replace(
+            self.config,
+            cap=int(state["cap"]),
+            max_fills=int(state["max_fills"]),
+            dtype=jnp.dtype(state["dtype"]),
+        )
+        self.n_slots = int(state["n_slots"])
+        self.max_t = int(state["max_t"])
+        b = state["books"]
+        self.books = jax.device_put(BookState(**b))
+        self.symbols = Interner.from_list(list(state["symbols"]))
+        self.oids = Interner.from_list(list(state["oids"]))
+        self.uids = Interner.from_list(list(state["uids"]))
+
     # -- views -------------------------------------------------------------
     def lane_books(self) -> BookState:
         return jax.device_get(self.books)

@@ -45,6 +45,18 @@ class Interner:
     def __len__(self) -> int:
         return len(self._to_str)
 
+    # -- snapshot support ----------------------------------------------------
+    def to_list(self) -> list[str]:
+        """All interned strings in id order (excluding the reserved 0)."""
+        return list(self._to_str[1:])
+
+    @classmethod
+    def from_list(cls, strs: list[str]) -> "Interner":
+        it = cls()
+        for s in strs:
+            it.intern(s)
+        return it
+
 
 @dataclasses.dataclass
 class OpContext:

@@ -1,0 +1,33 @@
+"""Durability — snapshot/restore + replay recovery (SURVEY §5.4).
+
+The reference gets durability for free: Redis IS the book, so every mutation
+is instantly persistent and restart = reconnect (redis.go:17-28; the queues
+are deliberately lossy, rabbitmq.go:64,102). The TPU build inverts the
+tiers: HBM arrays are primary, so durability must be explicit —
+
+  snapshot — periodic atomic dump of all mutable engine state (books,
+             interners, pre-pool) plus the bus cursors that make it a
+             *consistent cut*: the order-queue committed offset (everything
+             below it is IN the books) and the match-queue end offset
+             (everything below it was emitted FOR those orders).
+  replay   — on restore, rewind the order-queue consumer to the snapshot's
+             offset and truncate the match queue to its end offset; the
+             normal consumer loop then re-processes the tail
+             deterministically, regenerating the exact same events
+             (exactly-once on the match queue, vs the reference's
+             at-most-once).
+
+Requires the `file` bus backend for crash durability (the memory bus dies
+with the process — then snapshots still restore books, and the replay tail
+is empty, which is precisely the reference's crash model: in-flight
+messages lost, book state kept, SURVEY §2.3.6).
+
+An optional Redis *export* of the book in the reference's exact key schema
+(SURVEY §2.1) lives in redis_schema (commands are generated without a
+client; applying them is gated on redis-py being installed).
+"""
+
+from .snapshot import Persister, SnapshotStore
+from .redis_schema import book_redis_commands
+
+__all__ = ["Persister", "SnapshotStore", "book_redis_commands"]

@@ -95,7 +95,12 @@ def main(argv=None):
 
     argv = sys.argv[1:] if argv is None else argv
     config = load_config(argv[0] if argv else None)
-    svc = EngineService(config).start()
+    persist = None
+    if config.persist.enabled:
+        from ..persist import Persister
+
+        persist = Persister(config.persist)
+    svc = EngineService(config, persist=persist).start()
     log.info("engine service up (grpc %s:%d)", config.grpc.host, config.grpc.port)
     try:
         svc.wait()

@@ -1,0 +1,264 @@
+"""Durability tests: snapshot/restore, crash-replay recovery (exactly-once
+match stream), snapshot store atomicity/pruning, Redis-schema export."""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from gome_tpu.bus import decode_match_result, encode_order, make_bus
+from gome_tpu.config import BusConfig, Config, EngineConfig, PersistConfig
+from gome_tpu.persist import Persister, SnapshotStore, book_redis_commands
+from gome_tpu.persist.redis_schema import export_to_redis
+from gome_tpu.service import EngineService
+from gome_tpu.utils.streams import mixed_stream
+
+
+def make_svc(tmp_path, persist=True, **eng):
+    cfg = Config(
+        bus=BusConfig(backend="file", dir=str(tmp_path / "bus")),
+        engine=EngineConfig(cap=32, n_slots=8, max_t=8, **eng),
+        persist=PersistConfig(dir=str(tmp_path / "snaps"), every_n_batches=1),
+    )
+    p = Persister(cfg.persist) if persist else None
+    return EngineService(cfg, persist=p)
+
+
+def feed_orders(svc, orders):
+    for o in orders:
+        svc.engine.mark(o)
+        svc.bus.order_queue.publish(encode_order(o))
+
+
+def match_stream(svc):
+    mq = svc.bus.match_queue
+    return [decode_match_result(m.body) for m in mq.read_from(0, mq.end_offset())]
+
+
+def test_crash_recovery_exactly_once(tmp_path):
+    """Process half the stream, snapshot, process the rest, then 'crash'
+    (new process over the same dirs) WITHOUT a newer snapshot: recovery must
+    rebuild the books and regenerate the post-snapshot match tail
+    byte-identically — the full stream equals an uninterrupted run."""
+    orders = mixed_stream(n=200, seed=3, cancel_prob=0.25)
+
+    # Uninterrupted reference run (memory bus).
+    ref = EngineService(
+        Config(engine=EngineConfig(cap=32, n_slots=8, max_t=8))
+    )
+    feed_orders(ref, orders)
+    ref.pump()
+    expected = match_stream(ref)
+
+    svc = make_svc(tmp_path)
+    svc.persist.restore_latest()
+    feed_orders(svc, orders[:100])
+    svc.consumer.drain()
+    svc.persist.snapshot()
+    snap_match_end = svc.bus.match_queue.end_offset()
+    feed_orders(svc, orders[100:])
+    svc.consumer.drain()  # post-snapshot work that the crash will replay
+    assert svc.bus.match_queue.end_offset() >= snap_match_end
+
+    # --- crash: brand-new service over the same bus + snapshot dirs -------
+    svc2 = make_svc(tmp_path)
+    assert svc2.persist.restore_latest()
+    # consumer replays the order-log tail from the snapshot cut
+    svc2.consumer.drain()
+    assert match_stream(svc2) == expected
+    # book state equals the uninterrupted run's
+    b1 = ref.engine.batch.export_state()
+    b2 = svc2.engine.batch.export_state()
+    assert b1["symbols"] == b2["symbols"]
+    assert (b1["books"]["lots"] == b2["books"]["lots"]).all()
+    assert (b1["books"]["count"] == b2["books"]["count"]).all()
+
+
+def test_recovery_without_any_snapshot_replays_all(tmp_path):
+    """Crash before the first snapshot: the durable order log is the only
+    state, so recovery rewinds to offset 0 and the consumer replays the
+    whole log onto fresh books — no committed book state is lost."""
+    orders = mixed_stream(n=60, seed=5, cancel_prob=0.2)
+    svc = make_svc(tmp_path, persist=False)
+    feed_orders(svc, orders)
+    svc.consumer.drain()
+    expected = match_stream(svc)
+    expected_books = svc.engine.batch.export_state()
+
+    svc2 = make_svc(tmp_path)
+    assert not svc2.persist.restore_latest()
+    assert svc2.bus.order_queue.committed() == 0  # rewound for full replay
+    svc2.consumer.drain()
+    assert match_stream(svc2) == expected
+    got_books = svc2.engine.batch.export_state()
+    assert (expected_books["books"]["lots"] == got_books["books"]["lots"]).all()
+    assert (
+        expected_books["books"]["count"] == got_books["books"]["count"]
+    ).all()
+
+
+def test_recovery_does_not_resurrect_cancelled_order(tmp_path):
+    """A DEL consumed below the snapshot cut must suppress the mark
+    reconstruction for a same-key ADD in the replay tail: the cancel was
+    observable (its event is below match_end), so replay must keep dropping
+    the ADD rather than resurrecting a cancelled order."""
+    from gome_tpu.fixed import scale
+    from gome_tpu.types import Action, Order, Side
+
+    add = Order(uuid="u", oid="x", symbol="s", side=Side.BUY,
+                price=scale(1.0), volume=scale(1.0))
+    dele = Order(uuid="u", oid="x", symbol="s", side=Side.BUY,
+                 price=scale(1.0), volume=scale(1.0), action=Action.DEL)
+    probe = Order(uuid="v", oid="probe", symbol="s", side=Side.SALE,
+                  price=scale(1.0), volume=scale(1.0))
+
+    svc = make_svc(tmp_path)
+    # DEL consumed first (clears any mark for key s/u/x), then snapshot.
+    svc.bus.order_queue.publish(encode_order(dele))
+    svc.consumer.drain()
+    svc.persist.snapshot()
+    # The racing ADD lands in the queue after the cut; crash before consume.
+    # (Its in-memory mark dies with the process.)
+    svc.bus.order_queue.publish(encode_order(add))
+
+    svc2 = make_svc(tmp_path)
+    assert svc2.persist.restore_latest()
+    svc2.consumer.drain()
+    # The cancelled ADD must NOT have entered the book: a crossing probe
+    # finds nothing to hit and the book holds only the probe itself.
+    svc2.engine.mark(probe)
+    svc2.bus.order_queue.publish(encode_order(probe))
+    svc2.consumer.drain()
+    events = match_stream(svc2)
+    assert events == []  # no fill: resurrected ADD would have matched probe
+    books = svc2.engine.batch.lane_books()
+    assert int(books.count.sum()) == 1  # just the resting probe
+
+
+def test_uncommitted_tail_replays_after_crash(tmp_path):
+    """Crash BETWEEN publish and consume: orders in the log but never
+    processed are picked up by the next process (at-least-once — the
+    reference loses these outright, SURVEY §2.3.6)."""
+    orders = mixed_stream(n=40, seed=7)
+    svc = make_svc(tmp_path)
+    feed_orders(svc, orders)  # published, never drained -> crash
+    svc2 = make_svc(tmp_path)
+    svc2.persist.restore_latest()
+    # pre-pool marks died with process 1 (they're process state), but
+    # recovery reconstructs marks for queued ADDs from the order log.
+    n = svc2.consumer.drain()
+    assert n == len(orders)
+    ref = EngineService(Config(engine=EngineConfig(cap=32, n_slots=8, max_t=8)))
+    feed_orders(ref, orders)
+    ref.pump()
+    assert match_stream(svc2) == match_stream(ref)
+
+
+def test_snapshot_store_atomicity_and_pruning(tmp_path):
+    store = SnapshotStore(str(tmp_path / "s"), keep=2)
+    import numpy as np
+
+    for i in range(4):
+        store.save({"i": i}, {"a": np.arange(i + 1)})
+    ids = store._ids()
+    assert len(ids) == 2  # pruned to keep=2
+    manifest, books = store.load_latest()
+    assert manifest["i"] == 3 and len(books["a"]) == 4
+
+    # torn snapshot (no manifest) is skipped
+    torn = tmp_path / "s" / "snap-99"
+    torn.mkdir()
+    (torn / "books.npz").write_bytes(b"garbage")
+    manifest, _ = store.load_latest()
+    assert manifest["i"] == 3
+
+
+class FakeRedis:
+    """Minimal execute_command target for the gated export."""
+
+    def __init__(self):
+        self.zsets: dict[str, dict[str, float]] = {}
+        self.hashes: dict[str, dict[str, str]] = {}
+
+    def execute_command(self, *args):
+        cmd = args[0]
+        if cmd == "ZADD":
+            self.zsets.setdefault(args[1], {})[args[3]] = args[2]
+        elif cmd == "HSET":
+            self.hashes.setdefault(args[1], {})[args[2]] = args[3]
+        elif cmd == "FLUSHDB":
+            self.zsets.clear()
+            self.hashes.clear()
+        else:
+            raise AssertionError(f"unexpected {cmd}")
+
+
+def test_redis_schema_export(tmp_path):
+    svc = EngineService(Config(engine=EngineConfig(cap=32, n_slots=4, max_t=8)))
+    from gome_tpu.fixed import scale
+    from gome_tpu.types import Order, Side
+
+    orders = [
+        Order(uuid="7", oid="a", symbol="eth2usdt", side=Side.SALE,
+              price=scale(1.0), volume=scale(5.0)),
+        Order(uuid="8", oid="b", symbol="eth2usdt", side=Side.SALE,
+              price=scale(1.0), volume=scale(2.0)),  # same level, later FIFO
+        Order(uuid="9", oid="c", symbol="eth2usdt", side=Side.BUY,
+              price=scale(0.5), volume=scale(1.0)),
+    ]
+    feed_orders(svc, orders)
+    svc.pump()
+
+    fake = FakeRedis()
+    n = export_to_redis(svc.engine, client=fake)
+    assert n > 0
+    # zsets: one SALE level at 1e8, one BUY level at 0.5e8 (SURVEY §2.1)
+    assert fake.zsets["eth2usdt:SALE"] == {"100000000": 100000000.0}
+    assert fake.zsets["eth2usdt:BUY"] == {"50000000": 50000000.0}
+    # depth hash aggregates the level
+    assert fake.hashes["eth2usdt:depth"]["eth2usdt:depth:100000000"] == str(
+        scale(7.0)
+    )
+    # FIFO linked list: f -> a, l -> b, pointers chain a <-> b
+    link = fake.hashes["eth2usdt:link:100000000"]
+    assert link["f"] == "eth2usdt:node:a" and link["l"] == "eth2usdt:node:b"
+    node_a = json.loads(link["eth2usdt:node:a"])
+    node_b = json.loads(link["eth2usdt:node:b"])
+    assert node_a["IsFirst"] and not node_a["IsLast"]
+    assert node_a["NextNode"] == "eth2usdt:node:b"
+    assert node_b["PrevNode"] == "eth2usdt:node:a" and node_b["IsLast"]
+    assert node_a["Volume"] == scale(5.0)
+    # pre-pool marks exported under S:comparison S:U:O (ordernode.go:89-92)
+    svc.engine.pre_pool.add(("eth2usdt", "7", "zz"))
+    fake2 = FakeRedis()
+    export_to_redis(svc.engine, client=fake2)
+    assert fake2.hashes["eth2usdt:comparison"]["eth2usdt:7:zz"] == "1"
+
+
+def test_export_without_client_requires_redis():
+    svc = EngineService(Config(engine=EngineConfig(cap=32, n_slots=4, max_t=8)))
+    with pytest.raises(RuntimeError, match="redis-py is not installed"):
+        export_to_redis(svc.engine)
+
+
+def test_queue_rollback_truncate_guards(tmp_path):
+    bus = make_bus(BusConfig(backend="file", dir=str(tmp_path / "b")))
+    q = bus.order_queue
+    for i in range(5):
+        q.publish(b"%d" % i)
+    q.commit(4)
+    with pytest.raises(ValueError, match="forwards"):
+        q.rollback(5)
+    q.rollback(2)
+    assert q.committed() == 2
+    with pytest.raises(ValueError, match="below committed"):
+        q.truncate_to(1)
+    q.truncate_to(3)
+    assert q.end_offset() == 3
+    # truncation is durable across reopen
+    from gome_tpu.bus import FileQueue
+
+    q.close()
+    q2 = FileQueue("doOrder", str(tmp_path / "b" / "doOrder"))
+    assert q2.end_offset() == 3 and q2.committed() == 2
