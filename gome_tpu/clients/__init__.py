@@ -1,0 +1,14 @@
+"""Client drivers — the reference's L6 layer (SURVEY §1): standalone
+programs that exercise the service over gRPC.
+
+  load_client   — doorder.go:18-60's randomized order blaster
+  cancel_client — delorder.go:14-38's single cancel
+
+Run as modules:  python -m gome_tpu.clients.doorder [host:port]
+                 python -m gome_tpu.clients.delorder [host:port]
+"""
+
+from .doorder import load_client
+from .delorder import cancel_client
+
+__all__ = ["load_client", "cancel_client"]

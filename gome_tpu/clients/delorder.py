@@ -1,0 +1,47 @@
+"""Cancel client — behavioral port of gomengine/delorder.go:14-38: one
+DeleteOrder for a hardcoded order (uuid="2", oid="11", price=0.5,
+delorder.go:30-36). The cancel contract requires the exact resting price
+(SURVEY §2.3.2)."""
+
+from __future__ import annotations
+
+import grpc
+
+from ..api import order_pb2 as pb
+from ..api.service import OrderStub
+
+
+def cancel_client(
+    target: str,
+    uuid: str = "2",
+    oid: str = "11",
+    symbol: str = "eth2usdt",
+    transaction: int = 0,
+    price: float = 0.5,
+    volume: float = 1.0,
+) -> pb.OrderResponse:
+    with grpc.insecure_channel(target) as channel:
+        stub = OrderStub(channel)
+        return stub.DeleteOrder(
+            pb.OrderRequest(
+                uuid=uuid,
+                oid=oid,
+                symbol=symbol,
+                transaction=transaction,
+                price=price,
+                volume=volume,
+            )
+        )
+
+
+def main(argv=None):
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    target = argv[0] if argv else "127.0.0.1:8088"
+    resp = cancel_client(target)
+    print(f"code={resp.code} message={resp.message}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Load-test client — behavioral port of gomengine/doorder.go:18-60.
+
+Fires n-1 randomized limit orders (the reference's loop is
+`for i := 1; i < 2000` → 1,999 orders, doorder.go:37) at one symbol over
+gRPC: random BUY/SALE, price and volume uniform in (0,1] rounded to 2
+decimals (doorder.go:38-47's rand.Float64 + FloatRound(…, 2)), fixed
+uuid="2", oid = loop index. Reports throughput the reference never measured
+(SURVEY §6: baseline must be measured, not quoted).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import grpc
+
+from ..api import order_pb2 as pb
+from ..api.service import OrderStub
+
+
+def load_client(
+    target: str,
+    n: int = 2000,
+    symbol: str = "eth2usdt",
+    uuid: str = "2",
+    seed: int | None = None,
+    kind: int = 0,
+) -> dict:
+    """Send n-1 orders synchronously (the reference's serial loop); returns
+    {sent, ok, rejected, elapsed_s, orders_per_s}."""
+    rng = random.Random(seed)
+    sent = ok = rejected = 0
+    with grpc.insecure_channel(target) as channel:
+        stub = OrderStub(channel)
+        t0 = time.perf_counter()
+        for i in range(1, n):  # doorder.go:37 loop bounds
+            req = pb.OrderRequest(
+                uuid=uuid,
+                oid=str(i),
+                symbol=symbol,
+                transaction=rng.randrange(2),  # doorder.go:39-44
+                price=round(rng.uniform(0.01, 1.0), 2),
+                volume=round(rng.uniform(0.01, 1.0), 2),
+                kind=kind,
+            )
+            resp = stub.DoOrder(req)
+            sent += 1
+            if resp.code == 0:
+                ok += 1
+            else:
+                rejected += 1
+        elapsed = time.perf_counter() - t0
+    return {
+        "sent": sent,
+        "ok": ok,
+        "rejected": rejected,
+        "elapsed_s": elapsed,
+        "orders_per_s": sent / elapsed if elapsed > 0 else 0.0,
+    }
+
+
+def main(argv=None):
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    target = argv[0] if argv else "127.0.0.1:8088"
+    n = int(argv[1]) if len(argv) > 1 else 2000
+    stats = load_client(target, n=n)
+    print(
+        f"sent={stats['sent']} ok={stats['ok']} rejected={stats['rejected']} "
+        f"elapsed={stats['elapsed_s']:.2f}s rate={stats['orders_per_s']:.0f}/s"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -164,6 +164,10 @@ class Persister:
         loaded = self.store.load_latest()
         oq = self.bus.order_queue
         mq = self.bus.match_queue
+        # The pre-crash consumer position: tail messages below it were
+        # consumed by the crashed process (their effects may have been
+        # observable), messages at/above it never were.
+        consumed_to = oq.committed()
         if loaded is not None:
             manifest, books = loaded
             self.engine.batch.import_state({**manifest, "books": books})
@@ -184,7 +188,7 @@ class Persister:
             mq.rollback(0)
             mq.truncate_to(0)
         replayed = self._reconstruct_marks(
-            cut=oq.committed()
+            cut=oq.committed(), consumed_to=consumed_to
         )
         if loaded is not None or replayed:
             log.info(
@@ -194,44 +198,65 @@ class Persister:
             )
         return loaded is not None
 
-    def _reconstruct_marks(self, cut: int) -> int:
+    def _reconstruct_marks(self, cut: int, consumed_to: int) -> int:
         """Rebuild pre-pool marks for ADDs queued at/after `cut` (they were
         marked in the crashed process's memory: the gateway marks BEFORE
         publishing, main.go:44-45 ordering — so every queued ADD carried a
-        mark). A mark is NOT rebuilt when the key's latest message in the
-        committed region below the cut is a DEL: that DEL's consumption
-        cleared the mark durably-observably (its cancel event is below the
-        snapshot's match_end), and re-marking would resurrect a cancelled
-        order. Replay then reproduces the serialization where each mark
-        happens at its ADD's publish point — one of the real-time
-        interleavings the reference's racy pre-pool admits (SURVEY §2.3.3).
+        mark).
+
+        One refinement separates two cases by `consumed_to` (the pre-crash
+        consumer position):
+
+        * ADD consumed pre-crash (offset < consumed_to): its admission
+          decision may already be observable (fills delivered to live
+          subscribers), so replay must re-admit — always re-mark. The
+          realizable serialization: the mark was placed at publish time,
+          after every DEL consumed before it.
+        * ADD never consumed (offset >= consumed_to): no decision was made,
+          so any realizable interleaving is valid; we choose NOT to re-mark
+          when the key's latest committed message below the cut is a DEL —
+          that DEL's cancel semantics were observable (event below
+          match_end), and resurrecting a cancelled order would surprise
+          (SURVEY §2.3.3's race, resolved deterministically at recovery).
+
+        Residual ambiguity (documented, not resolvable from the log alone):
+        a DEL *inside* the consumed tail followed by a same-key ADD replays
+        as drop, while the crashed process may have raced to admit. Both
+        outcomes are realizable serializations of the reference's racy
+        pre-pool; eliminating the race entirely would need a durable mark
+        log (fsync per gateway mark — rejected as the wrong latency trade).
         """
         from ..bus import decode_order
         from ..types import Action
 
         oq = self.bus.order_queue
         tail = oq.read_from(cut, oq.end_offset() - cut)
-        tail_keys = set()
-        tail_adds = []
+        suppressible = set()  # keys of never-consumed ADDs
+        tail_adds: list[tuple[int, tuple]] = []
         for m in tail:
             order = decode_order(m.body)
             if order.action is Action.ADD:
                 key = (order.symbol, order.uuid, order.oid)
-                tail_keys.add(key)
-                tail_adds.append(key)
+                tail_adds.append((m.offset, key))
+                if m.offset >= consumed_to:
+                    suppressible.add(key)
         if not tail_adds:
             return len(tail)
-        # Last committed action per key of interest (scan is recovery-only).
+        # Last committed action per suppressible key (recovery-only scan).
         last_committed: dict[tuple, Action] = {}
         pos = 0
-        while pos < cut:
+        while pos < cut and suppressible:
             for m in oq.read_from(pos, min(4096, cut - pos)):
                 order = decode_order(m.body)
                 key = (order.symbol, order.uuid, order.oid)
-                if key in tail_keys:
+                if key in suppressible:
                     last_committed[key] = order.action
                 pos = m.offset + 1
-        for key in tail_adds:
-            if last_committed.get(key) is not Action.DEL:
-                self.engine.pre_pool.add(key)
+        for offset, key in tail_adds:
+            if (
+                offset >= consumed_to
+                and last_committed.get(key) is Action.DEL
+            ):
+                continue
+            self.engine.pre_pool.add(key)
         return len(tail)

@@ -19,8 +19,27 @@ import threading
 from ..bus import QueueBus, decode_order, encode_match_result
 from ..engine.orchestrator import MatchEngine
 from ..utils.logging import get_logger
+from ..utils.metrics import REGISTRY
+from ..utils.tracing import annotate
 
 log = get_logger("consumer")
+
+_orders_total = REGISTRY.counter(
+    "gome_orders_consumed_total", "orders drained from the doOrder queue"
+)
+_events_total = REGISTRY.counter(
+    "gome_match_events_total", "MatchResult events published"
+)
+_batch_size = REGISTRY.histogram(
+    "gome_batch_size", "orders per device micro-batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
+)
+_batch_latency = REGISTRY.histogram(
+    "gome_batch_seconds", "wall time per micro-batch (decode+match+publish)"
+)
+_throughput = REGISTRY.gauge(
+    "gome_orders_per_second", "EWMA matching throughput"
+)
 
 
 class OrderConsumer:
@@ -45,14 +64,24 @@ class OrderConsumer:
         msgs = self.bus.order_queue.poll_batch(self.batch_n, self.batch_wait_s)
         if not msgs:
             return 0
-        orders = [decode_order(m.body) for m in msgs]
-        events = self.engine.process(orders)
-        for ev in events:
-            self.bus.match_queue.publish(encode_match_result(ev))
-        # Commit only after results are published: a crash between processing
-        # and commit replays the batch (at-least-once; recovery dedup lives
-        # in gome_tpu.persist's replay logic).
-        self.bus.order_queue.commit(msgs[-1].offset + 1)
+        with _batch_latency.time() as timer:
+            with annotate("decode_orders"):
+                orders = [decode_order(m.body) for m in msgs]
+            with annotate("engine_process"):
+                events = self.engine.process(orders)
+            with annotate("publish_events"):
+                for ev in events:
+                    self.bus.match_queue.publish(encode_match_result(ev))
+            # Commit only after results are published: a crash between
+            # processing and commit replays the batch (at-least-once;
+            # recovery dedup lives in gome_tpu.persist's replay logic).
+            self.bus.order_queue.commit(msgs[-1].offset + 1)
+        _orders_total.inc(len(orders))
+        _events_total.inc(len(events))
+        _batch_size.observe(len(orders))
+        if timer.elapsed > 0:
+            inst = len(orders) / timer.elapsed
+            _throughput.set(0.8 * _throughput.value() + 0.2 * inst)
         if self.on_batch is not None:
             self.on_batch(len(orders), len(events))
         return len(orders)

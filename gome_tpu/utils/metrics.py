@@ -1,0 +1,201 @@
+"""Metrics — counters, gauges, and latency histograms with a Prometheus-style
+text exposition.
+
+The reference has no metrics at all (SURVEY §5.5 — logging only); the
+BASELINE.json throughput metric (orders/sec matched across N symbols) needs
+first-class instrumentation. Kept dependency-free and cheap: a metric update
+is a dict lookup + add under a lock shared per-registry.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def counter(self, name: str, help: str = "") -> "Counter":
+        return self._get(name, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> "Gauge":
+        return self._get(name, lambda: Gauge(name, help))
+
+    def histogram(
+        self, name: str, help: str = "", buckets: tuple = None
+    ) -> "Histogram":
+        return self._get(name, lambda: Histogram(name, help, buckets))
+
+    def _get(self, name, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            return m
+
+    def render(self) -> str:
+        """Prometheus text-format-ish exposition of every metric."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "\n".join(m.render() for m in metrics) + "\n"
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                name: m.value() for name, m in self._metrics.items()
+            }
+
+
+class Counter:
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, by: int = 1) -> None:
+        with self._lock:
+            self._v += by
+
+    def value(self):
+        with self._lock:
+            return self._v
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n# TYPE {self.name} counter\n"
+            f"{self.name} {self.value()}"
+        )
+
+
+class Gauge:
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = v
+
+    def value(self):
+        with self._lock:
+            return self._v
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n# TYPE {self.name} gauge\n"
+            f"{self.name} {self.value()}"
+        )
+
+
+_DEFAULT_BUCKETS = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+
+class Histogram:
+    """Fixed-bucket histogram (seconds by convention) with quantile
+    estimation by linear interpolation inside the winning bucket."""
+
+    def __init__(self, name: str, help: str = "", buckets: tuple = None):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(buckets or _DEFAULT_BUCKETS)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._n += 1
+
+    def time(self) -> "_Timer":
+        return _Timer(self)
+
+    def value(self) -> dict:
+        with self._lock:
+            return {
+                "count": self._n,
+                "sum": self._sum,
+                "mean": self._sum / self._n if self._n else 0.0,
+                "p50": self._quantile_locked(0.50),
+                "p95": self._quantile_locked(0.95),
+                "p99": self._quantile_locked(0.99),
+            }
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            return self._quantile_locked(q)
+
+    def _quantile_locked(self, q: float) -> float:
+        if self._n == 0:
+            return 0.0
+        target = q * self._n
+        cum = 0
+        for i, c in enumerate(self._counts):
+            if cum + c >= target:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = (
+                    self.buckets[i]
+                    if i < len(self.buckets)
+                    else self.buckets[-1] * 2
+                )
+                frac = (target - cum) / c if c else 0.0
+                return lo + (hi - lo) * frac
+            cum += c
+        return self.buckets[-1] * 2
+
+    def render(self) -> str:
+        # counts/sum/n must come from ONE lock acquisition: a concurrent
+        # observe between reads would make the +Inf line smaller than a
+        # finite bucket's cumulative count (invalid Prometheus data).
+        with self._lock:
+            counts = list(self._counts)
+            total = self._n
+            total_sum = self._sum
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            lines.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{self.name}_sum {total_sum}")
+        lines.append(f"{self.name}_count {total}")
+        return "\n".join(lines)
+
+
+class _Timer:
+    """Context manager recording one observation; exposes `elapsed` after
+    exit so callers reuse the same clock reading."""
+
+    elapsed: float = 0.0
+
+    def __init__(self, hist: Histogram):
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        self._hist.observe(self.elapsed)
+        return False
+
+
+# Process-global default registry (modules grab metrics from here).
+REGISTRY = Registry()

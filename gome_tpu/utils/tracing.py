@@ -1,0 +1,38 @@
+"""Profiling hooks — jax.profiler integration (SURVEY §5.1: the reference
+has no tracing/profiling at all; the TPU build gets device-level traces
+nearly for free and exposes them as first-class knobs).
+
+  trace(dir)        — context manager around jax.profiler.trace; produces a
+                      TensorBoard-loadable trace of every device op inside.
+  annotate(name)    — TraceAnnotation wrapper for host-side phases so batch
+                      packing/decoding shows up on the trace alongside XLA
+                      work.
+  maybe_trace(dir)  — no-op unless dir is set (config/env-driven).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def maybe_trace(log_dir: str | None):
+    if not log_dir:
+        yield
+        return
+    with trace(log_dir):
+        yield

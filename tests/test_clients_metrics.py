@@ -1,0 +1,137 @@
+"""Client drivers (doorder/delorder ports) + metrics/tracing tests."""
+
+from concurrent import futures
+
+import grpc
+import pytest
+
+from gome_tpu.api.service import add_order_servicer
+from gome_tpu.clients import cancel_client, load_client
+from gome_tpu.config import Config, EngineConfig
+from gome_tpu.oracle import OracleEngine
+from gome_tpu.service import EngineService
+from gome_tpu.utils.metrics import Registry
+from gome_tpu.utils.streams import doorder_stream
+
+
+@pytest.fixture
+def served():
+    svc = EngineService(
+        Config(engine=EngineConfig(cap=512, n_slots=4, max_t=2048))
+    )
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    add_order_servicer(server, svc.gateway)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    yield svc, f"127.0.0.1:{port}"
+    server.stop(grace=None)
+
+
+def test_load_client_drives_service(served):
+    """The doorder.go-shaped blaster (seeded) produces the same books as the
+    oracle fed the equivalent stream — full-stack parity under load."""
+    svc, target = served
+    stats = load_client(target, n=400, seed=123)
+    assert stats["sent"] == 399 and stats["rejected"] == 0
+    n = svc.pump()
+    assert n == 399
+
+    # Oracle referee: same RNG sequence as the client (mirrored generator).
+    import random
+
+    from gome_tpu.fixed import scale
+    from gome_tpu.types import Order, Side
+
+    rng = random.Random(123)
+    oracle = OracleEngine()
+    for i in range(1, 400):
+        side = Side(rng.randrange(2))
+        price = round(rng.uniform(0.01, 1.0), 2)
+        volume = round(rng.uniform(0.01, 1.0), 2)
+        oracle.process(
+            Order(
+                uuid="2", oid=str(i), symbol="eth2usdt", side=side,
+                price=scale(price), volume=scale(volume),
+            )
+        )
+    # Compare event streams via the match queue
+    from gome_tpu.bus import decode_match_result
+
+    mq = svc.bus.match_queue
+    got = [decode_match_result(m.body) for m in mq.read_from(0, mq.end_offset())]
+    assert got == oracle.events
+
+
+def test_cancel_client(served):
+    svc, target = served
+    from gome_tpu.api import order_pb2 as pb
+    from gome_tpu.api.service import OrderStub
+
+    with grpc.insecure_channel(target) as ch:
+        OrderStub(ch).DoOrder(
+            pb.OrderRequest(
+                uuid="2", oid="11", symbol="eth2usdt",
+                transaction=pb.SALE, price=0.5, volume=1.0,
+            )
+        )
+    svc.pump()
+    resp = cancel_client(target, transaction=1)  # delorder.go's hardcoded op
+    assert resp.code == 0
+    svc.pump()
+    books = svc.engine.batch.lane_books()
+    assert int(books.count.sum()) == 0
+
+
+def test_metrics_registry():
+    reg = Registry()
+    c = reg.counter("x_total", "things")
+    c.inc()
+    c.inc(4)
+    assert c.value() == 5
+    g = reg.gauge("g", "level")
+    g.set(2.5)
+    h = reg.histogram("lat_seconds", "latency")
+    for v in (0.001, 0.002, 0.003, 0.2):
+        h.observe(v)
+    v = h.value()
+    assert v["count"] == 4 and v["sum"] == pytest.approx(0.206)
+    assert 0.0005 < v["p50"] < 0.01
+    assert reg.counter("x_total") is c  # same instance by name
+    text = reg.render()
+    assert "x_total 5" in text and "# TYPE lat_seconds histogram" in text
+    assert 'lat_seconds_bucket{le="+Inf"} 4' in text
+    snap = reg.snapshot()
+    assert snap["g"] == 2.5
+
+
+def test_histogram_timer():
+    reg = Registry()
+    h = reg.histogram("t_seconds")
+    with h.time():
+        pass
+    assert h.value()["count"] == 1
+
+
+def test_consumer_updates_metrics():
+    from gome_tpu.bus import encode_order
+    from gome_tpu.utils.metrics import REGISTRY
+
+    before = REGISTRY.counter("gome_orders_consumed_total").value()
+    svc = EngineService(Config(engine=EngineConfig(cap=32, n_slots=4, max_t=8)))
+    for o in doorder_stream(n=20):
+        svc.engine.mark(o)
+        svc.bus.order_queue.publish(encode_order(o))
+    svc.pump()
+    assert REGISTRY.counter("gome_orders_consumed_total").value() == before + 20
+    assert REGISTRY.gauge("gome_orders_per_second").value() > 0
+
+
+def test_tracing_annotations_are_usable():
+    # host annotation + maybe_trace no-op path (full device trace exercised
+    # in bench/profiling runs, not unit tests)
+    from gome_tpu.utils.tracing import annotate, maybe_trace
+
+    with maybe_trace(None):
+        with annotate("unit-test-phase"):
+            x = 1 + 1
+    assert x == 2

@@ -15,11 +15,13 @@ from gome_tpu.service import EngineService
 from gome_tpu.utils.streams import mixed_stream
 
 
-def make_svc(tmp_path, persist=True, **eng):
+def make_svc(tmp_path, persist=True, every_n=1, **eng):
     cfg = Config(
         bus=BusConfig(backend="file", dir=str(tmp_path / "bus")),
         engine=EngineConfig(cap=32, n_slots=8, max_t=8, **eng),
-        persist=PersistConfig(dir=str(tmp_path / "snaps"), every_n_batches=1),
+        persist=PersistConfig(
+            dir=str(tmp_path / "snaps"), every_n_batches=every_n
+        ),
     )
     p = Persister(cfg.persist) if persist else None
     return EngineService(cfg, persist=p)
@@ -51,21 +53,29 @@ def test_crash_recovery_exactly_once(tmp_path):
     ref.pump()
     expected = match_stream(ref)
 
-    svc = make_svc(tmp_path)
+    # Cadence high enough that the ONLY snapshot is the explicit one below —
+    # so the crash leaves a genuine post-snapshot tail to replay.
+    svc = make_svc(tmp_path, every_n=10**9)
     svc.persist.restore_latest()
     feed_orders(svc, orders[:100])
     svc.consumer.drain()
     svc.persist.snapshot()
+    snap_cut = svc.bus.order_queue.committed()
     snap_match_end = svc.bus.match_queue.end_offset()
     feed_orders(svc, orders[100:])
     svc.consumer.drain()  # post-snapshot work that the crash will replay
-    assert svc.bus.match_queue.end_offset() >= snap_match_end
+    assert svc.bus.match_queue.end_offset() > snap_match_end
 
     # --- crash: brand-new service over the same bus + snapshot dirs -------
-    svc2 = make_svc(tmp_path)
+    svc2 = make_svc(tmp_path, every_n=10**9)
     assert svc2.persist.restore_latest()
+    # the restore rewound to the snapshot cut, leaving a real replay tail
+    assert svc2.bus.order_queue.committed() == snap_cut
+    assert svc2.bus.order_queue.end_offset() > snap_cut
+    assert svc2.bus.match_queue.end_offset() == snap_match_end  # truncated
     # consumer replays the order-log tail from the snapshot cut
-    svc2.consumer.drain()
+    replayed = svc2.consumer.drain()
+    assert replayed == len(orders) - 100
     assert match_stream(svc2) == expected
     # book state equals the uninterrupted run's
     b1 = ref.engine.batch.export_state()
@@ -153,6 +163,42 @@ def test_uncommitted_tail_replays_after_crash(tmp_path):
     feed_orders(ref, orders)
     ref.pump()
     assert match_stream(svc2) == match_stream(ref)
+
+
+def test_recovery_readmits_consumed_add_after_old_del(tmp_path):
+    """The flip side of resurrection suppression: an ADD that the crashed
+    process ADMITTED (consumed after the cut) must replay as admitted even
+    though an old committed DEL for the same key sits below the cut — its
+    fills may already have been observed downstream."""
+    from gome_tpu.fixed import scale
+    from gome_tpu.types import Action, Order, Side
+
+    key_add = Order(uuid="u", oid="x", symbol="s", side=Side.BUY,
+                    price=scale(1.0), volume=scale(1.0))
+    key_del = Order(uuid="u", oid="x", symbol="s", side=Side.BUY,
+                    price=scale(1.0), volume=scale(1.0), action=Action.DEL)
+    ask = Order(uuid="v", oid="a", symbol="s", side=Side.SALE,
+                price=scale(1.0), volume=scale(1.0))
+
+    svc = make_svc(tmp_path, every_n=10**9)
+    # Old DEL consumed and committed below the cut (clears nothing).
+    svc.bus.order_queue.publish(encode_order(key_del))
+    svc.consumer.drain()
+    svc.persist.snapshot()
+    # Post-cut: resting ask, then the gateway re-accepts the same key; the
+    # consumer admits it and it FILLS — an observable event.
+    svc.engine.mark(ask)
+    svc.bus.order_queue.publish(encode_order(ask))
+    svc.engine.mark(key_add)
+    svc.bus.order_queue.publish(encode_order(key_add))
+    svc.consumer.drain()
+    pre_crash = match_stream(svc)
+    assert len(pre_crash) == 1 and pre_crash[0].match_volume == scale(1.0)
+
+    svc2 = make_svc(tmp_path, every_n=10**9)
+    assert svc2.persist.restore_latest()
+    svc2.consumer.drain()
+    assert match_stream(svc2) == pre_crash  # fill regenerated identically
 
 
 def test_snapshot_store_atomicity_and_pruning(tmp_path):
