@@ -51,6 +51,27 @@ def make_bus(config) -> QueueBus:
         import os
 
         factory = lambda name: FileQueue(name, os.path.join(config.dir, name))
+    elif config.backend == "cfile":
+        import os
+
+        from .native import NativeFileQueue, native_available
+
+        if native_available():
+            factory = lambda name: NativeFileQueue(
+                name, os.path.join(config.dir, name)
+            )
+        else:
+            import warnings
+
+            warnings.warn(
+                "native queue library unavailable; falling back to the "
+                "Python file backend (same on-disk format)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            factory = lambda name: FileQueue(
+                name, os.path.join(config.dir, name)
+            )
     elif config.backend == "amqp":
         raise NotImplementedError(
             "amqp backend requires a RabbitMQ client library (pika/amqpstorm);"

@@ -55,6 +55,8 @@ class BusConfig:
     is preserved; the transport is pluggable (gome_tpu.bus backends):
       memory — in-process deques (single-binary deployments, tests)
       file   — durable append-only log segments (crash-safe, replayable)
+      cfile  — the same log format via the native C++ runtime library
+               (batch-amortized fsync; falls back to `file` if no toolchain)
       amqp   — external RabbitMQ (gated on a client lib being installed)
     """
 
@@ -67,7 +69,7 @@ class BusConfig:
     order_queue: str = "doOrder"  # rabbitmq.go: queue names
     match_queue: str = "matchOrder"
 
-    _BACKENDS = ("memory", "file", "amqp")
+    _BACKENDS = ("memory", "file", "cfile", "amqp")
 
     def __post_init__(self):
         if self.backend not in self._BACKENDS:
@@ -90,6 +92,7 @@ class EngineConfig:
     max_t: int = 32
     dtype: str = "int64"  # "int32" halves HBM traffic when ranges allow
     auto_grow: bool = True
+    kernel: str = "scan"  # scan (XLA) | pallas (VMEM-resident TPU kernel)
 
     def __post_init__(self):
         if not 0 <= self.accuracy <= 18:
@@ -100,6 +103,12 @@ class EngineConfig:
                 raise ValueError(f"engine.{name} must be positive, got {v}")
         if self.dtype not in ("int32", "int64"):
             raise ValueError(f"engine.dtype must be int32|int64, got {self.dtype}")
+        from .types import KERNELS
+
+        if self.kernel not in KERNELS:
+            raise ValueError(
+                f"engine.kernel must be one of {KERNELS}, got {self.kernel}"
+            )
 
     def book_config(self):
         from .engine.book import BookConfig

@@ -35,7 +35,7 @@ import functools
 import jax
 import numpy as np
 
-from ..types import Action, MatchResult, Order
+from ..types import KERNELS, Action, MatchResult, Order
 from .book import (
     BookConfig,
     BookState,
@@ -126,11 +126,19 @@ class BatchEngine:
         auto_grow: bool = True,
         max_slots: int = 1 << 16,
         max_cap: int = 1 << 14,
+        kernel: str = "scan",
     ):
         """max_slots / max_cap bound auto-grow (symbol lanes / per-side book
         capacity). Growth past a ceiling raises CapacityError instead of
         exhausting HBM — explicit backpressure the caller can surface
-        (the reference has no such ceiling because Redis pages to disk)."""
+        (the reference has no such ceiling because Redis pages to disk).
+
+        kernel: "scan" (XLA scan x vmap) or "pallas" (VMEM-resident Pallas
+        grid kernel, gome_tpu.ops.pallas_match; falls back to interpreter
+        mode off-TPU, so it is only a performance choice, never a
+        correctness one)."""
+        if kernel not in KERNELS:
+            raise ValueError(f"kernel must be one of {KERNELS}, got {kernel!r}")
         if config.cap > max_cap:
             raise ValueError(f"cap {config.cap} exceeds max_cap {max_cap}")
         if n_slots > max_slots:
@@ -141,6 +149,7 @@ class BatchEngine:
         self.auto_grow = auto_grow
         self.max_slots = max_slots
         self.max_cap = max_cap
+        self.kernel = kernel
         self.books = init_books(config, n_slots)
         self.symbols = Interner()  # symbol -> lane id + 1 offset handled below
         self.oids = Interner()
@@ -245,7 +254,7 @@ class BatchEngine:
         # before replaying — current resting count plus the ADDs packed into
         # the lane — so escalation costs one replay, not a doubling loop.
         while True:
-            new_books, outs = batch_step(self.config, books_before, ops)
+            new_books, outs = self._step(books_before, ops)
             self.stats.device_calls += 1
             host_flags = np.asarray(jax.device_get(outs.book_overflow))
             if not host_flags.any():
@@ -292,6 +301,25 @@ class BatchEngine:
             self.stats.device_calls += 1
             lane_overrides[lane] = jax.device_get(lane_out)
         return outs, lane_overrides
+
+    def _step(self, books: BookState, ops: DeviceOp):
+        """Run one [S, T] grid with the configured kernel. The Pallas path
+        requires S % block_s == 0 (n_slots growth keeps powers of two) and
+        interprets off-TPU; escalation re-runs (lane_scan) stay on the scan
+        path — they are rare and per-lane."""
+        if self.kernel == "pallas":
+            from ..ops import pallas_available, pallas_batch_step
+
+            s = ops.action.shape[0]
+            block_s = 8 if s % 8 == 0 else 1
+            return pallas_batch_step(
+                self.config,
+                books,
+                ops,
+                block_s=block_s,
+                interpret=not pallas_available(),
+            )
+        return batch_step(self.config, books, ops)
 
     # -- snapshot support ----------------------------------------------------
     def export_state(self) -> dict:

@@ -42,9 +42,14 @@ class MatchEngine:
         n_slots: int = 1024,
         max_t: int = 32,
         auto_grow: bool = True,
+        kernel: str = "scan",
     ):
         self.batch = BatchEngine(
-            config or BookConfig(), n_slots, max_t=max_t, auto_grow=auto_grow
+            config or BookConfig(),
+            n_slots,
+            max_t=max_t,
+            auto_grow=auto_grow,
+            kernel=kernel,
         )
         self.pre_pool: set[tuple[str, str, str]] = set()
 

@@ -1,0 +1,5 @@
+"""Custom TPU kernels (Pallas) for the matching hot path."""
+
+from .pallas_match import pallas_batch_step, pallas_available
+
+__all__ = ["pallas_batch_step", "pallas_available"]

@@ -1,0 +1,195 @@
+"""Pallas TPU kernel for the batched match step (SURVEY §7 step 4).
+
+What it buys over the XLA `scan x vmap` baseline (engine/batch.py): the scan
+materializes the full [S, 2, cap] book state to HBM on every one of the T
+time steps — ~2 x T x 5 arrays of HBM traffic per grid. This kernel blocks
+the symbol axis, loads one block's books into VMEM ONCE, applies all T ops
+with the books resident on-chip, and writes the final state back once:
+HBM traffic drops by ~T, and the T-step dependency chain runs entirely out
+of VMEM.
+
+Semantics are not re-implemented: the kernel body calls the SAME
+`step_impl` the scan path uses (vmap'd over the block's symbols), so the
+oracle-parity tests that pin step_impl pin this kernel too. The kernel is
+pure data movement + orchestration; matching math lives in exactly one
+place (engine/step.py).
+
+The kernel runs on TPU; everywhere else `pallas_batch_step(...,
+interpret=True)` executes the same code path in interpreter mode (used by
+the CPU test suite for parity).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..engine.book import BookConfig, BookState, DeviceOp, StepOutput
+from ..engine.step import step_impl
+
+
+def pallas_available() -> bool:
+    """True when the default backend can run the compiled kernel."""
+    return jax.default_backend() == "tpu"
+
+
+def _kernel(config: BookConfig, t_len: int, *refs):
+    """refs: 7 book-in + 7 op + 7 book-out + 14 StepOutput-out refs.
+
+    Layout per block (B = symbol block size):
+      book arrays   [B, 2, cap]  (count [B, 2], next_seq [B, 1])
+      op arrays     [B, T]
+      fill records  [B, T, K]
+      op scalars    [B, T]
+    """
+    (bp, bl, bs, bo, bu, bc, bn,
+     action, side, ismkt, oprice, ovol, ooid, ouid,
+     op_, ol_, os_, oo_, ou_, oc_, on_,
+     fp, fq, mo, mu, mp, mr, ta, nf, fo, tr, rs, bov, cf, cv) = refs
+
+    books = BookState(
+        price=bp[...],
+        lots=bl[...],
+        seq=bs[...],
+        oid=bo[...],
+        uid=bu[...],
+        count=bc[...],
+        next_seq=bn[...][:, 0],
+    )
+    step = jax.vmap(lambda b, o: step_impl(config, b, o))
+
+    def body(t, books):
+        op = DeviceOp(
+            action=action[:, t],
+            side=side[:, t],
+            is_market=ismkt[:, t],
+            price=oprice[:, t],
+            volume=ovol[:, t],
+            oid=ooid[:, t],
+            uid=ouid[:, t],
+        )
+        books, out = step(books, op)
+        # fill records [B, K] -> slot t of [B, T, K]
+        for ref, v in (
+            (fp, out.fill_price), (fq, out.fill_qty), (mo, out.maker_oid),
+            (mu, out.maker_uid), (mp, out.maker_prefill),
+            (mr, out.maker_remaining), (ta, out.taker_after),
+        ):
+            ref[:, pl.ds(t, 1), :] = v[:, None, :]
+        # per-op scalars [B] -> slot t of [B, T]
+        for ref, v in (
+            (nf, out.n_fills), (fo, out.fill_overflow),
+            (tr, out.taker_remaining), (rs, out.rested),
+            (bov, out.book_overflow), (cf, out.cancel_found),
+            (cv, out.cancel_volume),
+        ):
+            ref[:, pl.ds(t, 1)] = v[:, None]
+        return books
+
+    books = jax.lax.fori_loop(0, t_len, body, books)
+    op_[...] = books.price
+    ol_[...] = books.lots
+    os_[...] = books.seq
+    oo_[...] = books.oid
+    ou_[...] = books.uid
+    oc_[...] = books.count
+    on_[...] = books.next_seq[:, None]
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0,), static_argnames=("block_s", "interpret")
+)
+def pallas_batch_step(
+    config: BookConfig,
+    books: BookState,
+    ops: DeviceOp,
+    block_s: int = 8,
+    interpret: bool = False,
+) -> tuple[BookState, StepOutput]:
+    """Drop-in replacement for engine.batch.batch_step with identical
+    semantics (books [S, ...], ops [S, T] -> books', outs [S, T, ...]).
+    S must be a multiple of block_s (callers pad lanes; NOP rows are free).
+    """
+    s, t_len = ops.action.shape
+    if s % block_s != 0:
+        raise ValueError(f"S={s} not a multiple of block_s={block_s}")
+    cap = config.cap
+    k = config.max_fills
+    dt = config.dtype
+    sq = config.seq_dtype
+    grid = (s // block_s,)
+
+    def bspec(*shape):
+        # index_map: block i covers rows [i*block_s, (i+1)*block_s) and the
+        # full extent of every trailing axis.
+        nd = len(shape)
+        return pl.BlockSpec(
+            (block_s,) + shape, lambda i, _nd=nd: (i,) + (0,) * _nd
+        )
+
+    book_specs = [
+        bspec(2, cap), bspec(2, cap), bspec(2, cap), bspec(2, cap),
+        bspec(2, cap), bspec(2), bspec(1),
+    ]
+    op_specs = [bspec(t_len)] * 7
+    out_specs = (
+        book_specs
+        + [bspec(t_len, k)] * 7
+        + [bspec(t_len)] * 7
+    )
+    out_shape = (
+        [
+            jax.ShapeDtypeStruct((s, 2, cap), dt),  # price
+            jax.ShapeDtypeStruct((s, 2, cap), dt),  # lots
+            jax.ShapeDtypeStruct((s, 2, cap), sq),  # seq
+            jax.ShapeDtypeStruct((s, 2, cap), dt),  # oid
+            jax.ShapeDtypeStruct((s, 2, cap), dt),  # uid
+            jax.ShapeDtypeStruct((s, 2), jnp.int32),  # count
+            jax.ShapeDtypeStruct((s, 1), sq),  # next_seq
+        ]
+        + [jax.ShapeDtypeStruct((s, t_len, k), dt)] * 7  # fill records
+        + [
+            jax.ShapeDtypeStruct((s, t_len), jnp.int32),  # n_fills
+            jax.ShapeDtypeStruct((s, t_len), jnp.int32),  # fill_overflow
+            jax.ShapeDtypeStruct((s, t_len), dt),  # taker_remaining
+            jax.ShapeDtypeStruct((s, t_len), jnp.int32),  # rested
+            jax.ShapeDtypeStruct((s, t_len), jnp.int32),  # book_overflow
+            jax.ShapeDtypeStruct((s, t_len), jnp.int32),  # cancel_found
+            jax.ShapeDtypeStruct((s, t_len), dt),  # cancel_volume
+        ]
+    )
+
+    # Alias book inputs to book outputs: the kernel fully overwrites them,
+    # and aliasing lets the runtime reuse the (donated) buffers.
+    aliases = {i: i for i in range(7)}
+
+    outs = pl.pallas_call(
+        functools.partial(_kernel, config, t_len),
+        grid=grid,
+        in_specs=book_specs + op_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(
+        books.price, books.lots, books.seq, books.oid, books.uid,
+        books.count, books.next_seq[:, None],
+        ops.action, ops.side, ops.is_market, ops.price, ops.volume,
+        ops.oid, ops.uid,
+    )
+    (op_, ol_, os_, oo_, ou_, oc_, on_,
+     fp, fq, mo, mu, mp, mr, ta, nf, fo, tr, rs, bov, cf, cv) = outs
+    new_books = BookState(
+        price=op_, lots=ol_, seq=os_, oid=oo_, uid=ou_,
+        count=oc_, next_seq=on_[:, 0],
+    )
+    out = StepOutput(
+        fill_price=fp, fill_qty=fq, maker_oid=mo, maker_uid=mu,
+        maker_prefill=mp, maker_remaining=mr, taker_after=ta,
+        n_fills=nf, fill_overflow=fo, taker_remaining=tr, rested=rs,
+        book_overflow=bov, cancel_found=cf, cancel_volume=cv,
+    )
+    return new_books, out

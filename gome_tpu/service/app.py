@@ -35,6 +35,7 @@ class EngineService:
             n_slots=e.n_slots,
             max_t=e.max_t,
             auto_grow=e.auto_grow,
+            kernel=e.kernel,
         )
         self.persist = persist  # gome_tpu.persist.Persister or None
         on_batch = None

@@ -70,8 +70,16 @@ class OrderConsumer:
             with annotate("engine_process"):
                 events = self.engine.process(orders)
             with annotate("publish_events"):
-                for ev in events:
-                    self.bus.match_queue.publish(encode_match_result(ev))
+                bodies = [encode_match_result(ev) for ev in events]
+                publish_batch = getattr(
+                    self.bus.match_queue, "publish_batch", None
+                )
+                if publish_batch is not None and bodies:
+                    # native backend: one write+fsync for the whole batch
+                    publish_batch(bodies)
+                else:
+                    for body in bodies:
+                        self.bus.match_queue.publish(body)
             # Commit only after results are published: a crash between
             # processing and commit replays the batch (at-least-once;
             # recovery dedup lives in gome_tpu.persist's replay logic).

@@ -13,6 +13,11 @@ import enum
 from dataclasses import dataclass, replace
 
 
+# Device execution strategies for one [S, T] op grid (single source of
+# truth for config validation and BatchEngine selection).
+KERNELS = ("scan", "pallas")
+
+
 class Side(enum.IntEnum):
     """api/order.proto:4-7 — TransactionType {BUY=0, SALE=1}."""
 

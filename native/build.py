@@ -1,0 +1,53 @@
+"""Build the native runtime library (g++ -shared) with a content-hash cache.
+
+Invoked lazily by gome_tpu.bus.native on first use; safe to run directly:
+    python native/build.py
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SOURCES = ["filelog.cc"]
+LIB = "libgome_native.so"
+
+
+def build(verbose: bool = False) -> str | None:
+    """Compile if needed; returns the .so path or None when no toolchain."""
+    srcs = [os.path.join(HERE, s) for s in SOURCES]
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    out_dir = os.path.join(HERE, "build")
+    os.makedirs(out_dir, exist_ok=True)
+    stamp = os.path.join(out_dir, "source.sha256")
+    lib = os.path.join(out_dir, LIB)
+    digest = h.hexdigest()
+    if os.path.exists(lib) and os.path.exists(stamp):
+        with open(stamp) as f:
+            if f.read().strip() == digest:
+                return lib
+    cmd = [
+        "g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+        "-o", lib, *srcs,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    except (OSError, subprocess.CalledProcessError) as e:
+        if verbose:
+            print(f"native build failed: {e}", file=sys.stderr)
+        return None
+    with open(stamp, "w") as f:
+        f.write(digest)
+    return lib
+
+
+if __name__ == "__main__":
+    path = build(verbose=True)
+    print(path or "BUILD FAILED")
+    sys.exit(0 if path else 1)

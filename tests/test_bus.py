@@ -18,10 +18,20 @@ from gome_tpu.config import BusConfig
 from gome_tpu.types import Action, MatchResult, Order, OrderSnapshot, OrderType, Side
 
 
-@pytest.fixture(params=["memory", "file"])
+def _native_queue(tmp_path):
+    from gome_tpu.bus.native import NativeFileQueue, native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    return NativeFileQueue("doOrder", str(tmp_path / "doOrder"))
+
+
+@pytest.fixture(params=["memory", "file", "cfile"])
 def queue(request, tmp_path):
     if request.param == "memory":
         return MemoryQueue("doOrder")
+    if request.param == "cfile":
+        return _native_queue(tmp_path)
     return FileQueue("doOrder", str(tmp_path / "doOrder"))
 
 
@@ -104,6 +114,54 @@ def test_make_bus_topology(tmp_path):
     assert bus.match_queue.name == "matchOrder"
     bus.order_queue.publish(b"x")
     assert bus.match_queue.end_offset() == 0  # independent queues
+
+
+def test_native_python_on_disk_interop(tmp_path):
+    """The native and Python file queues share one on-disk format: a
+    directory written by either reopens correctly under the other,
+    including committed offsets and truncation."""
+    from gome_tpu.bus.native import NativeFileQueue, native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    base = str(tmp_path / "q")
+    # Python writes -> native reads
+    q = FileQueue("q", base)
+    for i in range(6):
+        q.publish(f"py-{i}".encode())
+    q.commit(2)
+    q.close()
+    nq = NativeFileQueue("q", base)
+    assert nq.end_offset() == 6 and nq.committed() == 2
+    assert [m.body for m in nq.read_from(2, 2)] == [b"py-2", b"py-3"]
+    # native appends + truncates -> Python reads
+    nq.publish_batch([b"c-0", b"c-1", b"c-2"])
+    nq.truncate_to(8)
+    nq.close()
+    q2 = FileQueue("q", base)
+    assert q2.end_offset() == 8
+    assert q2.read_from(6, 2)[0].body == b"c-0"
+    assert q2.read_from(7, 1)[0].body == b"c-1"
+
+
+def test_native_batch_publish_and_recovery(tmp_path):
+    from gome_tpu.bus.native import NativeFileQueue, native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    base = str(tmp_path / "q")
+    nq = NativeFileQueue("q", base)
+    first = nq.publish_batch([b"a" * 10, b"b" * 100, b"c"])
+    assert first == 0 and nq.end_offset() == 3
+    nq.commit(3)
+    nq.close()
+    # torn tail: native scanner truncates it away on reopen
+    with open(base + ".log", "ab") as f:
+        f.write(b"\x00\x00\x01\x00 torn")
+    nq2 = NativeFileQueue("q", base)
+    assert nq2.end_offset() == 3 and nq2.committed() == 3
+    assert nq2.read_from(1, 1)[0].body == b"b" * 100
+    nq2.close()
 
 
 def test_order_codec_roundtrip():
