@@ -68,7 +68,7 @@ def main():
         # sitecustomize; late override must go through jax.config.
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
-    import jax.numpy as jnp  # noqa: F401
+    import jax.numpy as jnp
 
     from gome_tpu.engine import BookConfig, batch_step, init_books
     from gome_tpu.engine.book import DeviceOp
@@ -77,15 +77,40 @@ def main():
     T = int(os.environ.get("BENCH_T", 4 if check else 16))
     G = int(os.environ.get("BENCH_GRIDS", 2 if check else 12))
     CAP = int(os.environ.get("BENCH_CAP", 32 if check else 128))
-    config = BookConfig(cap=CAP, max_fills=16)
-
-    stepper = jax.jit(
-        lambda books, ops: batch_step(config, books, ops),
-        donate_argnums=(0,),
+    KERNEL = os.environ.get("BENCH_KERNEL", "scan")  # scan | pallas
+    DTYPE = os.environ.get("BENCH_DTYPE", "int64")  # int64 | int32
+    config = BookConfig(
+        cap=CAP,
+        max_fills=16,
+        dtype=jnp.int32 if DTYPE == "int32" else jnp.int64,
     )
 
+    if KERNEL == "pallas":
+        from gome_tpu.ops import pallas_available, pallas_batch_step
+
+        interp = not pallas_available()
+        block_s = 8 if S % 8 == 0 else 1  # same fallback as BatchEngine._step
+        stepper = jax.jit(
+            lambda books, ops: pallas_batch_step(
+                config, books, ops, block_s=block_s, interpret=interp
+            ),
+            donate_argnums=(0,),
+        )
+    else:
+        stepper = jax.jit(
+            lambda books, ops: batch_step(config, books, ops),
+            donate_argnums=(0,),
+        )
+
     books = init_books(config, S)
-    grids = [DeviceOp(**g) for g in build_grids(S, T, G + 2)]
+    np_dtype = np.int32 if DTYPE == "int32" else np.int64
+    raw = build_grids(S, T, G + 2, dtype=np_dtype)
+    if DTYPE == "int32":
+        # int32 mode uses coarser lot units so per-side depth totals stay
+        # far from 2^31 (the documented int32-mode operating contract).
+        for d in raw:
+            d["volume"] = (d["volume"] // 1_000_000).astype(np_dtype)
+    grids = [DeviceOp(**g) for g in raw]
 
     # Warmup: compile + 2 grids (also fills books to steady state).
     books, outs = stepper(books, grids[0])
@@ -108,7 +133,7 @@ def main():
     orders = S * T * G
     throughput = orders / elapsed
     result = {
-        "metric": f"device matching throughput, {S} symbols x {T}-deep grids, cap={CAP}, int64 ticks",
+        "metric": f"device matching throughput, {S} symbols x {T}-deep grids, cap={CAP}, {DTYPE} ticks, {KERNEL} kernel",
         "value": round(throughput),
         "unit": "orders/sec",
         "vs_baseline": round(throughput / 1_000_000, 3),

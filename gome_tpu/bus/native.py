@@ -99,8 +99,13 @@ class NativeFileQueue(_Waitable, Queue):
 
     def _handle(self):
         """The open native handle; raises (instead of passing NULL into C,
-        which would segfault) if the queue was closed."""
-        h = self._h
+        which would segfault) if the queue was closed. Serialization of the
+        actual operations happens in the C library (Queue::mu); the Python
+        lock exists only to make close() atomic vs this check. Contract (as
+        for the Python backend): stop consumers before close() — a call
+        racing close() may still reach a freed handle."""
+        with self._lock:
+            h = self._h
         if not h:
             raise ValueError(f"queue {self.name!r} is closed")
         return h
@@ -116,8 +121,7 @@ class NativeFileQueue(_Waitable, Queue):
         n = len(bodies)
         lengths = (ctypes.c_uint32 * n)(*[len(b) for b in bodies])
         buf = (ctypes.c_ubyte * len(blob)).from_buffer_copy(blob)
-        with self._lock:
-            first = self._lib.gq_publish_batch(self._handle(), buf, lengths, n)
+        first = self._lib.gq_publish_batch(self._handle(), buf, lengths, n)
         if first < 0:
             raise OSError("native publish failed")
         self._notify_publish()
@@ -130,10 +134,9 @@ class NativeFileQueue(_Waitable, Queue):
         while True:
             bodies = (ctypes.c_ubyte * cap)()
             lengths = (ctypes.c_uint32 * max_n)()
-            with self._lock:
-                n = self._lib.gq_read_from(
-                    self._handle(), offset, max_n, bodies, cap, lengths
-                )
+            n = self._lib.gq_read_from(
+                self._handle(), offset, max_n, bodies, cap, lengths
+            )
             if n == -2:
                 raise OSError(
                     f"native read I/O error on queue {self.name!r} (log "
@@ -157,16 +160,13 @@ class NativeFileQueue(_Waitable, Queue):
                 raise OSError("native read: record set exceeds 1 GiB buffer")
 
     def end_offset(self) -> int:
-        with self._lock:
-            return int(self._lib.gq_end_offset(self._handle()))
+        return int(self._lib.gq_end_offset(self._handle()))
 
     def committed(self) -> int:
-        with self._lock:
-            return int(self._lib.gq_committed(self._handle()))
+        return int(self._lib.gq_committed(self._handle()))
 
     def commit(self, offset: int) -> None:
-        with self._lock:
-            rc = self._lib.gq_commit(self._handle(), offset)
+        rc = self._lib.gq_commit(self._handle(), offset)
         if rc == -1:
             raise ValueError(
                 f"commit out of range: {offset} (committed={self.committed()},"
@@ -176,16 +176,14 @@ class NativeFileQueue(_Waitable, Queue):
             raise OSError("native commit failed")
 
     def rollback(self, offset: int) -> None:
-        with self._lock:
-            rc = self._lib.gq_rollback(self._handle(), offset)
+        rc = self._lib.gq_rollback(self._handle(), offset)
         if rc == -1:
             raise ValueError(f"rollback going forwards: {offset}")
         if rc != 0:
             raise OSError("native rollback failed")
 
     def truncate_to(self, offset: int) -> None:
-        with self._lock:
-            rc = self._lib.gq_truncate_to(self._handle(), offset)
+        rc = self._lib.gq_truncate_to(self._handle(), offset)
         if rc == -1:
             raise ValueError(f"cannot truncate below committed: {offset}")
         if rc != 0:

@@ -4,10 +4,15 @@ from .mesh import (
     sharded_batch_step,
     symbol_sharding,
 )
+from .router import ShardedEngine, ShardRouter, fnv1a, multihost_mesh
 
 __all__ = [
     "make_mesh",
     "shard_batch",
     "sharded_batch_step",
     "symbol_sharding",
+    "ShardRouter",
+    "ShardedEngine",
+    "fnv1a",
+    "multihost_mesh",
 ]

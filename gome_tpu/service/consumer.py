@@ -70,16 +70,10 @@ class OrderConsumer:
             with annotate("engine_process"):
                 events = self.engine.process(orders)
             with annotate("publish_events"):
-                bodies = [encode_match_result(ev) for ev in events]
-                publish_batch = getattr(
-                    self.bus.match_queue, "publish_batch", None
+                # one write+fsync for the whole batch on the native backend
+                self.bus.match_queue.publish_batch(
+                    [encode_match_result(ev) for ev in events]
                 )
-                if publish_batch is not None and bodies:
-                    # native backend: one write+fsync for the whole batch
-                    publish_batch(bodies)
-                else:
-                    for body in bodies:
-                        self.bus.match_queue.publish(body)
             # Commit only after results are published: a crash between
             # processing and commit replays the batch (at-least-once;
             # recovery dedup lives in gome_tpu.persist's replay logic).

@@ -155,8 +155,17 @@ int64_t gq_publish_batch(void* h, const unsigned char* bodies,
     src += lengths[i];
   }
   ssize_t written = write(q->fd, buf.data(), buf.size());
-  if (written != ssize_t(buf.size())) return -1;
-  if (q->do_fsync && fsync(q->fd) != 0) return -1;
+  if (written != ssize_t(buf.size()) || (q->do_fsync && fsync(q->fd) != 0)) {
+    // Partial append (disk full/quota) or unconfirmed durability: roll the
+    // file back to the last consistent tail so positions never point into
+    // garbage and a reopen's scan cannot misparse orphan bytes.
+    if (ftruncate(q->fd, off_t(q->tail)) != 0) {
+      // Can't even restore consistency: poison the handle (fail-stop).
+      close(q->fd);
+      q->fd = -1;
+    }
+    return -1;
+  }
   int64_t first = int64_t(q->positions.size());
   q->positions.insert(q->positions.end(), new_positions.begin(),
                       new_positions.end());
@@ -184,16 +193,23 @@ int64_t gq_read_from(void* h, uint64_t offset, uint32_t max_n,
                      unsigned char* out_bodies, uint64_t out_cap,
                      uint32_t* out_lengths) {
   auto* q = static_cast<Queue*>(h);
-  std::lock_guard<std::mutex> lock(q->mu);
-  uint64_t end = q->positions.size();
-  if (offset >= end) return 0;
-  uint64_t n = end - offset;
-  if (n > max_n) n = max_n;
+  uint64_t start_pos, end_pos, n;
+  {
+    // Snapshot the byte range under the lock, then do the file I/O outside
+    // it so long reads (recovery replay) never stall the publish hot path.
+    // Records are immutable once indexed (truncate_to only removes whole
+    // records above the committed offset), so the snapshot stays valid.
+    std::lock_guard<std::mutex> lock(q->mu);
+    uint64_t end = q->positions.size();
+    if (offset >= end) return 0;
+    n = end - offset;
+    if (n > max_n) n = max_n;
+    start_pos = q->positions[offset];
+    end_pos =
+        (offset + n < q->positions.size()) ? q->positions[offset + n] : q->tail;
+  }
   FILE* f = fopen(q->log_path.c_str(), "rb");
   if (f == nullptr) return -2;
-  uint64_t start_pos = q->positions[offset];
-  uint64_t end_pos =
-      (offset + n < q->positions.size()) ? q->positions[offset + n] : q->tail;
   uint64_t span = end_pos - start_pos;
   std::vector<unsigned char> raw(span);
   bool ok = fseek(f, long(start_pos), SEEK_SET) == 0 &&

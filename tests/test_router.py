@@ -1,0 +1,82 @@
+"""Shard-routing tests: stable hashing, sharded-engine parity vs both the
+single engine and the oracle, per-shard isolation."""
+
+import pytest
+
+from gome_tpu.engine import BookConfig, MatchEngine
+from gome_tpu.oracle import OracleEngine
+from gome_tpu.parallel import ShardedEngine, ShardRouter, fnv1a
+from gome_tpu.utils.streams import multi_symbol_stream
+
+
+def test_routing_is_stable_and_total():
+    r = ShardRouter(8)
+    for sym in ("eth2usdt", "btc2usdt", "sym123", ""):
+        assert 0 <= r.route(sym) < 8
+        assert r.route(sym) == r.route(sym)
+    # fnv1a is the cross-process-stable hash (Python's is salted)
+    assert fnv1a("eth2usdt") == fnv1a("eth2usdt")
+    assert fnv1a("a") != fnv1a("b")
+    with pytest.raises(ValueError):
+        ShardRouter(0)
+
+
+def test_sharded_engine_matches_oracle():
+    """4 shards, 12 symbols, mixed flow with cancels: the merged event
+    stream must equal the oracle's (global FIFO) when processed with exact
+    arrival-order boundaries."""
+    orders = multi_symbol_stream(
+        n=400, n_symbols=12, seed=4, cancel_prob=0.2
+    )
+    oracle = OracleEngine()
+    expected = []
+    for o in orders:
+        expected.extend(oracle.process(o))
+
+    eng = ShardedEngine(
+        4, config=BookConfig(cap=32, max_fills=8), n_slots=8, max_t=16
+    )
+    for o in orders:
+        eng.mark(o)
+    got = eng.process_with_arrival_order(orders)
+    assert got == expected
+
+
+def test_sharded_engine_batched_same_events_per_symbol():
+    """The fast batched path may interleave independent symbols differently
+    but must produce the identical per-symbol event subsequences."""
+    orders = multi_symbol_stream(n=300, n_symbols=9, seed=6, cancel_prob=0.15)
+    single = MatchEngine(config=BookConfig(cap=32, max_fills=8), n_slots=16)
+    for o in orders:
+        single.mark(o)
+    expected = single.process(orders)
+
+    eng = ShardedEngine(
+        3, config=BookConfig(cap=32, max_fills=8), n_slots=8, max_t=16
+    )
+    for o in orders:
+        eng.mark(o)
+    got = eng.process(orders)
+
+    def per_symbol(evs):
+        out = {}
+        for e in evs:
+            out.setdefault(e.node.symbol, []).append(e)
+        return out
+
+    assert per_symbol(got) == per_symbol(expected)
+
+
+def test_shards_isolated():
+    eng = ShardedEngine(4, config=BookConfig(cap=16, max_fills=4), n_slots=4)
+    from gome_tpu.fixed import scale
+    from gome_tpu.types import Order, Side
+
+    o = Order(uuid="u", oid="1", symbol="onlysym", side=Side.BUY,
+              price=scale(1.0), volume=scale(1.0))
+    eng.mark(o)
+    eng.process([o])
+    owner = eng.router.route("onlysym")
+    for i, shard in enumerate(eng.shards):
+        count = int(shard.batch.lane_books().count.sum())
+        assert count == (1 if i == owner else 0)
