@@ -6,14 +6,31 @@ all the Redis round trips behind them (SURVEY §3.2: ~6 + 2·levels + 4·fills
 RTTs per order) — with a fixed number of O(cap) vector operations:
 
   match   = prefix mask + one exclusive cumsum + clip      (engine.go:118-198)
-  removal = left-shift gather of the filled prefix         (nodelink.go:124-166)
-  rest    = right-shift gather insert at the priority slot (nodepool.go:31-46)
-  cancel  = masked locate + left-shift gather              (engine.go:87-116)
+  removal = left-shift of the filled prefix                (nodelink.go:124-166)
+  rest    = right-shift insert at the priority slot        (nodepool.go:31-46)
+  cancel  = masked locate + left-shift                     (engine.go:87-116)
 
 Everything is branch-free (ADD and DEL paths are both computed and selected
 by mask) so the function vmaps cleanly across the symbol axis and compiles
 to a static XLA graph — no data-dependent control flow, per the TPU design
-rules. Scalar semantics are checked against the Python oracle in
+rules.
+
+TPU lowering discipline — the entire step is gather/scatter-free:
+
+  * Side selection (`own` = the taker's side, `opp` = the opposing side) is
+    NOT a dynamic index into the [2, cap] axis (under vmap that lowers to a
+    per-row gather, and the write-back to a per-row scatter — both serialize
+    badly on TPU). Both rows are read with static slices and selected
+    elementwise by the side mask; write-back re-stacks two static rows.
+  * The match compaction ("drop the fully-filled prefix of length n") is NOT
+    a dynamic-offset gather. It is decomposed into log2(cap) static
+    shift-by-2^k passes, each enabled by one bit of n — every pass is a
+    static slice + pad + select, which XLA fuses into the surrounding
+    elementwise work.
+  * Insert/cancel shifts are static shift-by-one selects; the cancel-volume
+    read is a masked sum, not a dynamic scalar index.
+
+Scalar semantics are checked against the Python oracle in
 tests/test_engine_step.py; the oracle is the spec (SURVEY §7 step 1).
 """
 
@@ -35,6 +52,16 @@ ACTION_ADD = int(Action.ADD)
 ACTION_DEL = int(Action.DEL)
 
 
+def _shl1(a):
+    """Static shift-by-one toward index 0, zero-filling the tail."""
+    return jnp.pad(a[1:], (0, 1))
+
+
+def _shr1(a):
+    """Static shift-by-one away from index 0, zero-filling the head."""
+    return jnp.pad(a[:-1], (1, 0))
+
+
 class _Side(NamedTuple):
     """One side's slot arrays (a row of each BookState array)."""
 
@@ -46,25 +73,43 @@ class _Side(NamedTuple):
 
     def shift_left(self, by, cap: int) -> "_Side":
         """Drop `by` leading slots (removals always form a prefix after a
-        match; an arbitrary slot for cancels is handled by _remove)."""
-        idx = jnp.arange(cap)
-        src = jnp.clip(idx + by, 0, cap - 1)
-        keep = idx + by < cap
+        match; an arbitrary slot for cancels is handled by _remove).
 
-        def g(a):
-            return jnp.where(keep, a[src], jnp.zeros_like(a))
+        `by` is data-dependent, so a direct a[i + by] lowers to a per-lane
+        gather under vmap. Instead: binary-decompose the shift into static
+        shift-by-2^k slices, each selected by bit k of `by` — O(log cap)
+        fused elementwise passes, no gather (SURVEY §7 hard part (a), done
+        the XLA-friendly way).
+        """
+        out = list(self)
+        k = 0
+        while (1 << k) <= cap:
+            sh = 1 << k
+            on = ((by >> k) & 1) != 0
 
-        return _Side(*(g(a) for a in self))
+            def g(a, sh=sh, on=on):
+                shifted = jnp.pad(a[sh:], (0, min(sh, cap)))
+                return jnp.where(on, shifted, a)
+
+            out = [g(a) for a in out]
+            k += 1
+        return _Side(*out)
 
 
-def _side_of(book: BookState, s) -> _Side:
-    return _Side(
-        price=book.price[s],
-        lots=book.lots[s],
-        seq=book.seq[s],
-        oid=book.oid[s],
-        uid=book.uid[s],
-    )
+def _rows(arr, s):
+    """Select (own, opp) rows of a [2, cap] array elementwise by side mask
+    (static slices + select; never a dynamic index on the side axis)."""
+    r0, r1 = arr[0], arr[1]
+    is_buy = s == BUY
+    return jnp.where(is_buy, r0, r1), jnp.where(is_buy, r1, r0)
+
+
+def _unrows(own_row, opp_row, s):
+    """Inverse of _rows: re-stack (own, opp) into [2, cap] by side mask."""
+    is_buy = s == BUY
+    r0 = jnp.where(is_buy, own_row, opp_row)
+    r1 = jnp.where(is_buy, opp_row, own_row)
+    return jnp.stack([r0, r1])
 
 
 def _match(
@@ -126,10 +171,8 @@ def _insert(config: BookConfig, own: _Side, own_count, entry: _Side, side):
     pos = jnp.sum(active & beats).astype(jnp.int32)
     overflow = own_count >= cap
 
-    src = jnp.clip(idx - 1, 0, cap - 1)
-
     def ins(a, v):
-        shifted = jnp.where(idx > pos, a[src], a)
+        shifted = jnp.where(idx > pos, _shr1(a), a)
         return jnp.where(idx == pos, jnp.asarray(v, a.dtype), shifted)
 
     new = _Side(*(ins(a, v) for a, v in zip(own, entry)))
@@ -146,15 +189,13 @@ def _remove(config: BookConfig, own: _Side, own_count, oid, price):
     active = idx < own_count
     hit = active & (own.oid == oid) & (own.price == price)
     found = jnp.any(hit)
-    pos = jnp.argmax(hit).astype(jnp.int32)  # oids unique by contract
-    volume = jnp.where(found, own.lots[pos], 0)
-
-    src = jnp.clip(idx + 1, 0, cap - 1)
+    # oids unique by contract, so the hit mask has at most one set slot:
+    # masked sums replace the dynamic argmax-index reads (gather-free).
+    pos = jnp.sum(jnp.where(hit, idx, 0)).astype(jnp.int32)
+    volume = jnp.sum(jnp.where(hit, own.lots, 0))
 
     def rm(a):
-        return jnp.where(
-            idx >= pos, jnp.where(idx + 1 < cap, a[src], jnp.zeros_like(a)), a
-        )
+        return jnp.where(idx >= pos, _shl1(a), a)
 
     removed = _Side(*(rm(a) for a in own))
     new = jax.tree.map(lambda n, o: jnp.where(found, n, o), removed, own)
@@ -172,14 +213,16 @@ def step_impl(
     graph static (TPU design rule: no data-dependent control flow).
     """
     s = op.side
-    o = 1 - s
     is_add = op.action == ACTION_ADD
     is_del = op.action == ACTION_DEL
 
-    own0 = _side_of(book, s)
-    opp0 = _side_of(book, o)
-    own_count0 = book.count[s]
-    opp_count0 = book.count[o]
+    rows = {
+        name: _rows(getattr(book, name), s)
+        for name in ("price", "lots", "seq", "oid", "uid")
+    }
+    own0 = _Side(*(rows[n][0] for n in _Side._fields))
+    opp0 = _Side(*(rows[n][1] for n in _Side._fields))
+    own_count0, opp_count0 = _rows(book.count, s)
 
     # --- ADD: match against the opposing side -------------------------------
     opp1, opp_count1, remaining, fills = _match(
@@ -227,16 +270,13 @@ def step_impl(
     opp_final = sel(opp1, opp0, opp0)
     opp_count_final = jnp.where(is_add, opp_count1, opp_count0)
 
-    def write(arr, row_s, row_o):
-        return arr.at[s].set(row_s).at[o].set(row_o)
-
     new_book = BookState(
-        price=write(book.price, own_final.price, opp_final.price),
-        lots=write(book.lots, own_final.lots, opp_final.lots),
-        seq=write(book.seq, own_final.seq, opp_final.seq),
-        oid=write(book.oid, own_final.oid, opp_final.oid),
-        uid=write(book.uid, own_final.uid, opp_final.uid),
-        count=book.count.at[s].set(own_count_final).at[o].set(opp_count_final),
+        price=_unrows(own_final.price, opp_final.price, s),
+        lots=_unrows(own_final.lots, opp_final.lots, s),
+        seq=_unrows(own_final.seq, opp_final.seq, s),
+        oid=_unrows(own_final.oid, opp_final.oid, s),
+        uid=_unrows(own_final.uid, opp_final.uid, s),
+        count=_unrows(own_count_final, opp_count_final, s),
         next_seq=jnp.where(do_rest, book.next_seq + 1, book.next_seq),
     )
 
