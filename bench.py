@@ -12,13 +12,24 @@ value / 1e6, i.e. the fraction of the 1M orders/sec goal achieved.
 Method: S symbol lanes x T time slots of real limit orders (tight price
 band around mid so flows cross and match constantly), packed host-side with
 numpy, executed as G chained batch_step calls (scan over T x vmap over S)
-with donated book state, synchronized per call (block_until_ready). Per-call
-sync is the honest production shape — the consumer drains a micro-batch,
-waits for results, publishes events — and avoids pathological pipelined
-dispatch over tunneled-TPU transports. Grids are staged onto the device
-before timing (BENCH_STAGED=0 to include host->device transfer in the
-loop). Orders/sec counts every non-NOP op applied to a book. Run
-`python bench.py --check` for a tiny self-check on any platform.
+with donated book state. Synchronization discipline: the device runs the
+G-grid chain without ANY host round trip; each grid's StepOutput is folded
+into a device-side scalar accumulator (total fills + total overflows), and
+ONE data-dependent scalar fetch closes the timed region. This matters
+doubly on a tunneled TPU (host<->device round trips cost ~0.1-1s flat), and
+it is also the production shape: the consumer keeps the device fed and
+decodes event batches asynchronously, off the critical path. Orders/sec
+counts every op applied to a book. Run `python bench.py --check` for a tiny
+self-check on any platform.
+
+Dtype note: the default is BENCH_DTYPE=int32 + the VMEM-resident Pallas
+kernel — the high-throughput configuration, valid for workloads whose
+tick/lot ranges keep per-side depth prefix sums under 2^31 (the bench's
+int32 grids use coarser lot units accordingly). BENCH_DTYPE=int64 selects
+the exact-integer envelope of the reference's accuracy=8 fixed-point
+scaling (SURVEY §2.2) — prefix sums over a full (default 256-slot) side
+can exceed 2^31 at 1e8-scaled lots — and runs on the scan path (Mosaic has
+no 64-bit lowering).
 """
 
 from __future__ import annotations
@@ -58,9 +69,14 @@ def build_grids(s, t, g, seed=0, dtype=np.int64):
 
 def main():
     check = "--check" in sys.argv
+    DTYPE = os.environ.get("BENCH_DTYPE", "int32")  # int64 | int32
     import jax
 
-    jax.config.update("jax_enable_x64", True)
+    # x64 only when the book dtype needs it: with x64 on, every jnp.arange /
+    # Python-int literal inside the kernel promotes to int64, which Mosaic
+    # (Pallas TPU) rejects and which doubles index-array traffic.
+    if DTYPE == "int64":
+        jax.config.update("jax_enable_x64", True)
     if check:
         jax.config.update("jax_platforms", "cpu")
     elif os.environ.get("BENCH_PLATFORM"):
@@ -75,10 +91,14 @@ def main():
 
     S = int(os.environ.get("BENCH_SYMBOLS", 64 if check else 10240))
     T = int(os.environ.get("BENCH_T", 4 if check else 16))
-    G = int(os.environ.get("BENCH_GRIDS", 2 if check else 12))
-    CAP = int(os.environ.get("BENCH_CAP", 32 if check else 128))
-    KERNEL = os.environ.get("BENCH_KERNEL", "scan")  # scan | pallas
-    DTYPE = os.environ.get("BENCH_DTYPE", "int64")  # int64 | int32
+    G = int(os.environ.get("BENCH_GRIDS", 2 if check else 48))
+    CAP = int(os.environ.get("BENCH_CAP", 32 if check else 256))
+    # Default = the high-throughput configuration: VMEM-resident Pallas
+    # kernel on int32 ticks. BENCH_DTYPE=int64 selects the exact-envelope
+    # configuration (accuracy=8 with unbounded depth sums), which runs on
+    # the scan path (Mosaic has no 64-bit lowering).
+    default_kernel = "pallas" if DTYPE == "int32" else "scan"
+    KERNEL = os.environ.get("BENCH_KERNEL", default_kernel)  # scan | pallas
     config = BookConfig(
         cap=CAP,
         max_fills=16,
@@ -88,8 +108,12 @@ def main():
     if KERNEL == "pallas":
         from gome_tpu.ops import pallas_available, pallas_batch_step
 
-        interp = not pallas_available()
-        block_s = 8 if S % 8 == 0 else 1  # same fallback as BatchEngine._step
+        interp = not pallas_available(config.dtype)
+        block_s = int(
+            os.environ.get(
+                "BENCH_BLOCK_S", next(b for b in (128, 8, 1) if S % b == 0)
+            )
+        )
         stepper = jax.jit(
             lambda books, ops: pallas_batch_step(
                 config, books, ops, block_s=block_s, interpret=interp
@@ -102,6 +126,20 @@ def main():
             donate_argnums=(0,),
         )
 
+    # Per-grid device-side reduction of the outputs the host actually
+    # watches during a bench: fills and overflow count. Per-grid sums fit
+    # int32 comfortably (S*T*K < 2^31); the cross-grid total is accumulated
+    # host-side in Python ints after ONE stacked fetch, so no wrap is
+    # possible at any run length even with x64 off.
+    fold = jax.jit(
+        lambda o: jnp.stack([jnp.sum(o.n_fills), jnp.sum(o.book_overflow)])
+    )
+    add = jax.jit(lambda a, b: a + b)
+    # Device accumulators are int32 when x64 is off; flushing to host Python
+    # ints every FLUSH_EVERY grids keeps the on-device partial far from 2^31
+    # at any run length (per-grid fills <= S*T*K < 2^31).
+    FLUSH_EVERY = 256
+
     books = init_books(config, S)
     np_dtype = np.int32 if DTYPE == "int32" else np.int64
     raw = build_grids(S, T, G + 2, dtype=np_dtype)
@@ -112,24 +150,45 @@ def main():
             d["volume"] = (d["volume"] // 1_000_000).astype(np_dtype)
     grids = [DeviceOp(**g) for g in raw]
 
-    # Warmup: compile + 2 grids (also fills books to steady state).
-    books, outs = stepper(books, grids[0])
-    jax.block_until_ready(books)
-    books, outs = stepper(books, grids[1])
-    jax.block_until_ready(books)
-
-    timed = grids[2:]
+    # Stage all grids on device before timing (BENCH_STAGED=0 to include
+    # host->device transfer in the loop).
     if os.environ.get("BENCH_STAGED", "1") != "0":
-        timed = [jax.device_put(g) for g in timed]
-        jax.block_until_ready(timed)
+        grids = [jax.device_put(g) for g in grids]
+        jax.block_until_ready(grids)
 
+    # Warmup: compile + 2 grids (also fills books to steady state, and warms
+    # every graph the timed loop uses — nothing compiles inside the timing).
+    # The scalar int() fetch is the only reliable completion barrier on
+    # tunneled backends (block_until_ready can return at enqueue).
+    books, outs = stepper(books, grids[0])
+    acc = fold(outs)
+    books, outs = stepper(books, grids[1])
+    acc = add(acc, fold(outs))
+    int(acc[0])
+
+    totals = np.zeros(2, np.int64)
+    acc = None
     t0 = time.perf_counter()
-    for grid in timed:
+    for i, grid in enumerate(grids[2:]):
         books, outs = stepper(books, grid)
-        jax.block_until_ready(books)
-    total_fills = jax.device_get(outs.n_fills).sum()
+        acc = fold(outs) if acc is None else add(acc, fold(outs))
+        if (i + 1) % FLUSH_EVERY == 0:
+            totals += np.asarray(jax.device_get(acc), np.int64)
+            acc = None
+    if acc is not None:
+        # Final data-dependent fetch = the completion barrier.
+        totals += np.asarray(jax.device_get(acc), np.int64)
     elapsed = time.perf_counter() - t0
+    total_fills, overflows = int(totals[0]), int(totals[1])
 
+    if overflows:
+        # A production engine escalates cap and replays (BatchEngine);
+        # the bench must instead be configured so the budget never trips.
+        print(
+            f"# WARNING: {overflows} book overflows at cap={CAP} — raise "
+            "BENCH_CAP for an honest run",
+            file=sys.stderr,
+        )
     orders = S * T * G
     throughput = orders / elapsed
     result = {
@@ -142,7 +201,7 @@ def main():
     if os.environ.get("BENCH_VERBOSE"):
         print(
             f"# elapsed={elapsed:.3f}s orders={orders} "
-            f"last_grid_fills={int(total_fills)} platform="
+            f"fills={total_fills} platform="
             f"{jax.devices()[0].platform}",
             file=sys.stderr,
         )

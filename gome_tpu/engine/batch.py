@@ -33,6 +33,7 @@ import dataclasses
 import functools
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..types import KERNELS, Action, MatchResult, Order
@@ -46,13 +47,45 @@ from .book import (
     init_books,
 )
 from .host import Interner, OpContext, decode_events, encode_op
-from .step import ACTION_ADD, step_impl
+from .step import ACTION_ADD, _Side, step_rows_impl
+
+
+def _book_to_rows(book: BookState):
+    """BookState -> per-side rows carry (static slices, done ONCE per grid).
+    The scan carries rows so no step pays the [2, cap] side-axis restack
+    (5 jnp.stack materializations per step in the naive form)."""
+    buy = _Side(*(getattr(book, n)[..., 0, :] for n in _Side._fields))
+    sale = _Side(*(getattr(book, n)[..., 1, :] for n in _Side._fields))
+    return (buy, sale, book.count[..., 0], book.count[..., 1], book.next_seq)
+
+
+def _rows_to_book(rows) -> BookState:
+    buy, sale, nb, ns, nseq = rows
+    pair = lambda b, a: jnp.stack([b, a], axis=-2)
+    return BookState(
+        price=pair(buy.price, sale.price),
+        lots=pair(buy.lots, sale.lots),
+        seq=pair(buy.seq, sale.seq),
+        oid=pair(buy.oid, sale.oid),
+        uid=pair(buy.uid, sale.uid),
+        count=jnp.stack([nb, ns], axis=-1),
+        next_seq=nseq,
+    )
 
 
 def _lane_scan_impl(config: BookConfig, book: BookState, ops_lane: DeviceOp):
     """One symbol's op sequence on one (unstacked) book — the single shared
     scan body for both the full grid (under vmap) and escalation re-runs."""
-    return jax.lax.scan(lambda b, op: step_impl(config, b, op), book, ops_lane)
+
+    def body(rows, op):
+        buy, sale, nb, ns, nseq = rows
+        buy, sale, nb, ns, nseq, out = step_rows_impl(
+            config, buy, sale, nb, ns, nseq, op
+        )
+        return (buy, sale, nb, ns, nseq), out
+
+    rows, outs = jax.lax.scan(body, _book_to_rows(book), ops_lane)
+    return _rows_to_book(rows), outs
 
 
 @functools.partial(jax.jit, static_argnums=0)
@@ -127,6 +160,7 @@ class BatchEngine:
         max_slots: int = 1 << 16,
         max_cap: int = 1 << 14,
         kernel: str = "scan",
+        pallas_interpret: bool = False,
     ):
         """max_slots / max_cap bound auto-grow (symbol lanes / per-side book
         capacity). Growth past a ceiling raises CapacityError instead of
@@ -134,9 +168,12 @@ class BatchEngine:
         (the reference has no such ceiling because Redis pages to disk).
 
         kernel: "scan" (XLA scan x vmap) or "pallas" (VMEM-resident Pallas
-        grid kernel, gome_tpu.ops.pallas_match; falls back to interpreter
-        mode off-TPU, so it is only a performance choice, never a
-        correctness one)."""
+        grid kernel, gome_tpu.ops.pallas_match). "pallas" silently uses the
+        scan path whenever the compiled kernel cannot run (off-TPU, int64
+        books, unblockable lane counts) — identical semantics either way, so
+        the choice is purely a performance one. pallas_interpret=True forces
+        the (slow) Pallas interpreter instead of that fallback; it exists so
+        CPU tests can exercise the kernel's code path."""
         if kernel not in KERNELS:
             raise ValueError(f"kernel must be one of {KERNELS}, got {kernel!r}")
         if config.cap > max_cap:
@@ -150,6 +187,7 @@ class BatchEngine:
         self.max_slots = max_slots
         self.max_cap = max_cap
         self.kernel = kernel
+        self._pallas_interpret = pallas_interpret
         self.books = init_books(config, n_slots)
         self.symbols = Interner()  # symbol -> lane id + 1 offset handled below
         self.oids = Interner()
@@ -311,14 +349,24 @@ class BatchEngine:
             from ..ops import pallas_available, pallas_batch_step
 
             s = ops.action.shape[0]
-            block_s = 8 if s % 8 == 0 else 1
-            return pallas_batch_step(
-                self.config,
-                books,
-                ops,
-                block_s=block_s,
-                interpret=not pallas_available(),
-            )
+            # Lane-dim blocking rule of the compiled kernel: 128-multiples,
+            # or one block spanning the whole axis.
+            block_s = 128 if s % 128 == 0 else (s if s <= 128 else None)
+            if self._pallas_interpret and block_s is None:
+                block_s = next(b for b in (8, 1) if s % b == 0)
+            if block_s is not None and (
+                pallas_available(self.config.dtype) or self._pallas_interpret
+            ):
+                return pallas_batch_step(
+                    self.config,
+                    books,
+                    ops,
+                    block_s=block_s,
+                    interpret=not pallas_available(self.config.dtype),
+                )
+            # int64 books, off-TPU, or lane counts the kernel cannot block:
+            # the scan path has identical semantics at full speed (the
+            # interpreter is a test vehicle, not a production fallback).
         return batch_step(self.config, books, ops)
 
     # -- snapshot support ----------------------------------------------------
@@ -342,8 +390,6 @@ class BatchEngine:
         """Restore a state exported by export_state (snapshot recovery).
         Replaces books, interners, and geometry; stats are NOT restored
         (counters describe a process lifetime, not book state)."""
-        import jax.numpy as jnp
-
         self.config = dataclasses.replace(
             self.config,
             cap=int(state["cap"]),

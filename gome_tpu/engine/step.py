@@ -52,6 +52,31 @@ ACTION_ADD = int(Action.ADD)
 ACTION_DEL = int(Action.DEL)
 
 
+def _bsel(c, a, b):
+    """Select `a` where `c` else `b`, for a scalar-per-lane bool `c` and
+    vector operands. Written as an integer blend (m*a + (1-m)*b) instead of
+    jnp.where: under vmap a scalar predicate broadcasts to a [B, cap] i1
+    vector, and Mosaic (Pallas TPU) cannot relayout 1-bit vectors across
+    the minor dims — the i32 mask broadcast is supported everywhere and
+    fuses identically under XLA."""
+    m = jnp.asarray(c, a.dtype)
+    return m * a + (1 - m) * b
+
+
+def _prefix_sum(a):
+    """Inclusive prefix sum along the last axis via Hillis-Steele log-shift
+    passes (static slice + pad + add). Used instead of jnp.cumsum because
+    Mosaic (Pallas TPU) has no cumsum lowering; XLA fuses the passes into the
+    surrounding elementwise work either way."""
+    n = a.shape[-1]
+    k = 1
+    while k < n:
+        pad = [(0, 0)] * (a.ndim - 1) + [(k, 0)]
+        a = a + jnp.pad(a[..., :-k], pad)
+        k *= 2
+    return a
+
+
 def _shl1(a):
     """Static shift-by-one toward index 0, zero-filling the tail."""
     return jnp.pad(a[1:], (0, 1))
@@ -88,28 +113,17 @@ class _Side(NamedTuple):
             on = ((by >> k) & 1) != 0
 
             def g(a, sh=sh, on=on):
-                shifted = jnp.pad(a[sh:], (0, min(sh, cap)))
-                return jnp.where(on, shifted, a)
+                if sh >= cap:
+                    # Whole-array shift: avoid the zero-size slice a[cap:]
+                    # (Mosaic rejects 0-length vectors).
+                    shifted = jnp.zeros_like(a)
+                else:
+                    shifted = jnp.pad(a[sh:], (0, sh))
+                return _bsel(on, shifted, a)
 
             out = [g(a) for a in out]
             k += 1
         return _Side(*out)
-
-
-def _rows(arr, s):
-    """Select (own, opp) rows of a [2, cap] array elementwise by side mask
-    (static slices + select; never a dynamic index on the side axis)."""
-    r0, r1 = arr[0], arr[1]
-    is_buy = s == BUY
-    return jnp.where(is_buy, r0, r1), jnp.where(is_buy, r1, r0)
-
-
-def _unrows(own_row, opp_row, s):
-    """Inverse of _rows: re-stack (own, opp) into [2, cap] by side mask."""
-    is_buy = s == BUY
-    r0 = jnp.where(is_buy, own_row, opp_row)
-    r1 = jnp.where(is_buy, opp_row, own_row)
-    return jnp.stack([r0, r1])
 
 
 def _match(
@@ -125,13 +139,19 @@ def _match(
     """
     cap = config.cap
     k = config.max_fills
-    idx = jnp.arange(cap)
+    idx = jnp.arange(cap, dtype=jnp.int32)
     active = idx < opp_count
-    crosses_price = jnp.where(side == BUY, opp.price <= price, opp.price >= price)
-    crossing = active & (crosses_price | (is_market != 0))
+    # The side/market predicates are scalar-per-lane; combine them with the
+    # [cap] masks through i32 blends (_bsel) — a scalar i1 broadcast against
+    # a vector has no Mosaic relayout.
+    le = (opp.price <= price).astype(jnp.int32)
+    ge = (opp.price >= price).astype(jnp.int32)
+    mkt = (is_market != 0).astype(jnp.int32)
+    crosses = jnp.maximum(_bsel(side == BUY, le, ge), mkt)
+    crossing = active & (crosses != 0)
 
     clots = jnp.where(crossing, opp.lots, 0)
-    cum_excl = jnp.cumsum(clots) - clots
+    cum_excl = _prefix_sum(clots) - clots
     fill = jnp.clip(volume - cum_excl, 0, clots)
     total = jnp.sum(fill)
     remaining = volume - total
@@ -165,9 +185,11 @@ def _insert(config: BookConfig, own: _Side, own_count, entry: _Side, side):
     at the last slot whose priority beats or equals the new order — existing
     same-price orders keep time priority (nodelink.go:53-64)."""
     cap = config.cap
-    idx = jnp.arange(cap)
+    idx = jnp.arange(cap, dtype=jnp.int32)
     active = idx < own_count
-    beats = jnp.where(side == BUY, own.price >= entry.price, own.price <= entry.price)
+    ge = (own.price >= entry.price).astype(jnp.int32)
+    le = (own.price <= entry.price).astype(jnp.int32)
+    beats = _bsel(side == BUY, ge, le) != 0
     pos = jnp.sum(active & beats).astype(jnp.int32)
     overflow = own_count >= cap
 
@@ -176,7 +198,7 @@ def _insert(config: BookConfig, own: _Side, own_count, entry: _Side, side):
         return jnp.where(idx == pos, jnp.asarray(v, a.dtype), shifted)
 
     new = _Side(*(ins(a, v) for a, v in zip(own, entry)))
-    new = jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new, own)
+    new = jax.tree.map(lambda n, o: _bsel(overflow, o, n), new, own)
     return new, jnp.where(overflow, own_count, own_count + 1), overflow
 
 
@@ -185,7 +207,7 @@ def _remove(config: BookConfig, own: _Side, own_count, oid, price):
     price (SURVEY §2.3.2 — the reference looks up S:link:P by price); no
     ownership check (uid is deliberately not compared)."""
     cap = config.cap
-    idx = jnp.arange(cap)
+    idx = jnp.arange(cap, dtype=jnp.int32)
     active = idx < own_count
     hit = active & (own.oid == oid) & (own.price == price)
     found = jnp.any(hit)
@@ -198,14 +220,24 @@ def _remove(config: BookConfig, own: _Side, own_count, oid, price):
         return jnp.where(idx >= pos, _shl1(a), a)
 
     removed = _Side(*(rm(a) for a in own))
-    new = jax.tree.map(lambda n, o: jnp.where(found, n, o), removed, own)
+    new = jax.tree.map(lambda n, o: _bsel(found, n, o), removed, own)
     return new, jnp.where(found, own_count - 1, own_count), found, volume
 
 
-def step_impl(
-    config: BookConfig, book: BookState, op: DeviceOp
-) -> tuple[BookState, StepOutput]:
-    """Apply one op to one symbol's book. Pure, jittable, vmap-able.
+def step_rows_impl(
+    config: BookConfig,
+    buy: _Side,
+    sale: _Side,
+    buy_count,
+    sale_count,
+    next_seq,
+    op: DeviceOp,
+) -> tuple[_Side, _Side, jax.Array, jax.Array, jax.Array, StepOutput]:
+    """Apply one op to one symbol's book, given as separate per-side rows.
+
+    This is the core the Pallas kernel calls directly (per-side [cap] rows
+    tile densely in VMEM; a [2, cap] side axis would stack/unstack every
+    step). step_impl wraps it for the [2, cap] BookState representation.
 
     Both the ADD path (match + rest) and the DEL path (cancel) are computed
     unconditionally and mask-selected — under vmap over symbols `lax.cond`
@@ -215,14 +247,12 @@ def step_impl(
     s = op.side
     is_add = op.action == ACTION_ADD
     is_del = op.action == ACTION_DEL
+    is_buy = s == BUY
 
-    rows = {
-        name: _rows(getattr(book, name), s)
-        for name in ("price", "lots", "seq", "oid", "uid")
-    }
-    own0 = _Side(*(rows[n][0] for n in _Side._fields))
-    opp0 = _Side(*(rows[n][1] for n in _Side._fields))
-    own_count0, opp_count0 = _rows(book.count, s)
+    own0 = _Side(*(_bsel(is_buy, b, a) for b, a in zip(buy, sale)))
+    opp0 = _Side(*(_bsel(is_buy, a, b) for b, a in zip(buy, sale)))
+    own_count0 = jnp.where(is_buy, buy_count, sale_count)
+    opp_count0 = jnp.where(is_buy, sale_count, buy_count)
 
     # --- ADD: match against the opposing side -------------------------------
     opp1, opp_count1, remaining, fills = _match(
@@ -235,7 +265,7 @@ def step_impl(
     entry = _Side(
         price=op.price,
         lots=remaining,
-        seq=book.next_seq + 1,
+        seq=next_seq + 1,
         oid=op.oid,
         uid=op.uid,
     )
@@ -249,16 +279,14 @@ def step_impl(
     # --- select & write back ------------------------------------------------
     def sel(add_side, del_side, nop_side):
         return jax.tree.map(
-            lambda a, d, n: jnp.where(
-                is_add, a, jnp.where(is_del, d, n)
-            ),
+            lambda a, d, n: _bsel(is_add, a, _bsel(is_del, d, n)),
             add_side,
             del_side,
             nop_side,
         )
 
     own_final = sel(
-        jax.tree.map(lambda r, o_: jnp.where(do_rest, r, o_), own1, own0),
+        jax.tree.map(lambda r, o_: _bsel(do_rest, r, o_), own1, own0),
         own2,
         own0,
     )
@@ -270,25 +298,25 @@ def step_impl(
     opp_final = sel(opp1, opp0, opp0)
     opp_count_final = jnp.where(is_add, opp_count1, opp_count0)
 
-    new_book = BookState(
-        price=_unrows(own_final.price, opp_final.price, s),
-        lots=_unrows(own_final.lots, opp_final.lots, s),
-        seq=_unrows(own_final.seq, opp_final.seq, s),
-        oid=_unrows(own_final.oid, opp_final.oid, s),
-        uid=_unrows(own_final.uid, opp_final.uid, s),
-        count=_unrows(own_count_final, opp_count_final, s),
-        next_seq=jnp.where(do_rest, book.next_seq + 1, book.next_seq),
+    new_buy = _Side(
+        *(_bsel(is_buy, o_, p) for o_, p in zip(own_final, opp_final))
     )
+    new_sale = _Side(
+        *(_bsel(is_buy, p, o_) for o_, p in zip(own_final, opp_final))
+    )
+    new_buy_count = jnp.where(is_buy, own_count_final, opp_count_final)
+    new_sale_count = jnp.where(is_buy, opp_count_final, own_count_final)
+    new_next_seq = jnp.where(do_rest, next_seq + 1, next_seq)
 
     zero = jnp.zeros((), config.dtype)
     out = StepOutput(
-        fill_price=jnp.where(is_add, fills["fill_price"], 0),
-        fill_qty=jnp.where(is_add, fills["fill_qty"], 0),
-        maker_oid=jnp.where(is_add, fills["maker_oid"], 0),
-        maker_uid=jnp.where(is_add, fills["maker_uid"], 0),
-        maker_prefill=jnp.where(is_add, fills["maker_prefill"], 0),
-        maker_remaining=jnp.where(is_add, fills["maker_remaining"], 0),
-        taker_after=jnp.where(is_add, fills["taker_after"], 0),
+        fill_price=_bsel(is_add, fills["fill_price"], 0),
+        fill_qty=_bsel(is_add, fills["fill_qty"], 0),
+        maker_oid=_bsel(is_add, fills["maker_oid"], 0),
+        maker_uid=_bsel(is_add, fills["maker_uid"], 0),
+        maker_prefill=_bsel(is_add, fills["maker_prefill"], 0),
+        maker_remaining=_bsel(is_add, fills["maker_remaining"], 0),
+        taker_after=_bsel(is_add, fills["taker_after"], 0),
         n_fills=jnp.where(is_add, fills["n_fills"], 0),
         fill_overflow=jnp.where(is_add, fills["fill_overflow"], 0),
         taker_remaining=jnp.where(is_add, remaining, zero),
@@ -296,6 +324,30 @@ def step_impl(
         book_overflow=(do_rest & overflow).astype(jnp.int32),
         cancel_found=(is_del & found).astype(jnp.int32),
         cancel_volume=jnp.where(is_del, cancel_volume, zero),
+    )
+    return new_buy, new_sale, new_buy_count, new_sale_count, new_next_seq, out
+
+
+def step_impl(
+    config: BookConfig, book: BookState, op: DeviceOp
+) -> tuple[BookState, StepOutput]:
+    """Apply one op to one symbol's [2, cap] BookState. Pure, jittable,
+    vmap-able. Thin wrapper over step_rows_impl: unstack the side axis with
+    static slices, run the rows core, restack (the stack is XLA-only — the
+    Pallas kernel keeps per-side rows and never pays it)."""
+    buy = _Side(*(getattr(book, n)[0] for n in _Side._fields))
+    sale = _Side(*(getattr(book, n)[1] for n in _Side._fields))
+    new_buy, new_sale, nb, ns, nseq, out = step_rows_impl(
+        config, buy, sale, book.count[0], book.count[1], book.next_seq, op
+    )
+    new_book = BookState(
+        price=jnp.stack([new_buy.price, new_sale.price]),
+        lots=jnp.stack([new_buy.lots, new_sale.lots]),
+        seq=jnp.stack([new_buy.seq, new_sale.seq]),
+        oid=jnp.stack([new_buy.oid, new_sale.oid]),
+        uid=jnp.stack([new_buy.uid, new_sale.uid]),
+        count=jnp.stack([nb, ns]),
+        next_seq=nseq,
     )
     return new_book, out
 

@@ -1,22 +1,41 @@
 """Pallas TPU kernel for the batched match step (SURVEY §7 step 4).
 
 What it buys over the XLA `scan x vmap` baseline (engine/batch.py): the scan
-materializes the full [S, 2, cap] book state to HBM on every one of the T
-time steps — ~2 x T x 5 arrays of HBM traffic per grid. This kernel blocks
-the symbol axis, loads one block's books into VMEM ONCE, applies all T ops
-with the books resident on-chip, and writes the final state back once:
-HBM traffic drops by ~T, and the T-step dependency chain runs entirely out
-of VMEM.
+materializes the full book state — and every one of the ~60 elementwise
+passes over it — to HBM on each of the T time steps. This kernel blocks the
+symbol axis, loads one block's books into VMEM ONCE, applies all T ops with
+the books resident on-chip, and writes the final state back once:
+intermediate HBM traffic disappears and the T-step dependency chain runs
+entirely out of VMEM.
 
 Semantics are not re-implemented: the kernel body calls the SAME
-`step_impl` the scan path uses (vmap'd over the block's symbols), so the
-oracle-parity tests that pin step_impl pin this kernel too. The kernel is
-pure data movement + orchestration; matching math lives in exactly one
-place (engine/step.py).
+`step_rows_impl` core the scan path's step_impl wraps, so the oracle-parity
+tests that pin the step pin this kernel too. The kernel is pure data
+movement + orchestration; matching math lives in exactly one place
+(engine/step.py).
 
-The kernel runs on TPU; everywhere else `pallas_batch_step(...,
-interpret=True)` executes the same code path in interpreter mode (used by
-the CPU test suite for parity).
+TPU layout discipline (Mosaic tiles the minor two dims as (8, 128) and only
+allows unaligned dynamic offsets on the major dim):
+
+  * book arrays ship as per-side [S, cap] rows (10 arrays) — the public
+    [S, 2, cap] BookState is sliced/restacked OUTSIDE the kernel. A [2, cap]
+    side axis inside would waste 4x on the size-2 sublane dim and need an
+    offset-concat restack every step, which Mosaic cannot lower.
+  * the 7 op fields ship packed in ONE [T, 8, S] int32 array (row 7 spare);
+    each step reads the [8, B] slab at its (major-dim, unaligned-ok) time
+    index and peels rows.
+  * the 7 per-op scalar outputs come back the same way: one [T, 8, S] pack.
+  * the 7 per-op fill-record arrays come back time-leading as [T, K, S];
+    the step's [B, K] records are transposed in-VMEM so the lane dim stays
+    the (dense) symbol block.
+The host repacks to the public [S, T, ...] StepOutput shapes outside the
+kernel — pure XLA transposes, off the hot dependency chain.
+
+The compiled kernel is int32-only (Mosaic has no 64-bit lowering);
+BookConfig dtype=int64 callers use the scan path. On TPU
+`pallas_available()` gates the choice; everywhere else
+`pallas_batch_step(..., interpret=True)` executes the same code path in
+interpreter mode (used by the CPU test suite for parity).
 """
 
 from __future__ import annotations
@@ -28,75 +47,87 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..engine.book import BookConfig, BookState, DeviceOp, StepOutput
-from ..engine.step import step_impl
+from ..engine.step import _Side, step_rows_impl
+
+_REC_FIELDS = (
+    "fill_price", "fill_qty", "maker_oid", "maker_uid",
+    "maker_prefill", "maker_remaining", "taker_after",
+)
+_SCALAR_FIELDS = (
+    "n_fills", "fill_overflow", "taker_remaining", "rested",
+    "book_overflow", "cancel_found", "cancel_volume",
+)
+_OP_FIELDS = ("action", "side", "is_market", "price", "volume", "oid", "uid")
 
 
-def pallas_available() -> bool:
-    """True when the default backend can run the compiled kernel."""
-    return jax.default_backend() == "tpu"
+def pallas_available(dtype=jnp.int32) -> bool:
+    """True when the default backend can run the compiled kernel. Mosaic has
+    no 64-bit vector lowering, so int64 books always take the scan path."""
+    return jax.default_backend() == "tpu" and jnp.dtype(dtype).itemsize <= 4
 
 
 def _kernel(config: BookConfig, t_len: int, *refs):
-    """refs: 7 book-in + 7 op + 7 book-out + 14 StepOutput-out refs.
+    """refs: 12 book-in (5 buy rows, 5 sale rows, count, next_seq) +
+    1 op-pack-in + 12 book-out + 7 record-out + 1 scalar-pack-out.
+    See module docstring for layouts."""
+    (bb_p, bb_l, bb_s, bb_o, bb_u, sb_p, sb_l, sb_s, sb_o, sb_u,
+     cnt, nsq, ops,
+     ob_p, ob_l, ob_s, ob_o, ob_u, os_p, os_l, os_s, os_o, os_u,
+     ocnt, onsq,
+     fp, fq, mo, mu, mp, mr, ta, scal) = refs
+    rec_refs = (fp, fq, mo, mu, mp, mr, ta)
 
-    Layout per block (B = symbol block size):
-      book arrays   [B, 2, cap]  (count [B, 2], next_seq [B, 1])
-      op arrays     [B, T]
-      fill records  [B, T, K]
-      op scalars    [B, T]
-    """
-    (bp, bl, bs, bo, bu, bc, bn,
-     action, side, ismkt, oprice, ovol, ooid, ouid,
-     op_, ol_, os_, oo_, ou_, oc_, on_,
-     fp, fq, mo, mu, mp, mr, ta, nf, fo, tr, rs, bov, cf, cv) = refs
+    buy = _Side(bb_p[...], bb_l[...], bb_s[...], bb_o[...], bb_u[...])
+    sale = _Side(sb_p[...], sb_l[...], sb_s[...], sb_o[...], sb_u[...])
+    counts = cnt[...]  # [B, 2]
+    # Loop carries stay rank-2: Mosaic's layout inference crashes on rank-1
+    # vectors carried through fori_loop (layout.h implicit-dim check); the
+    # [B, 1] squeeze/unsqueeze inside the body is free.
+    carry = (buy, sale, counts[:, 0:1], counts[:, 1:2], nsq[...])
 
-    books = BookState(
-        price=bp[...],
-        lots=bl[...],
-        seq=bs[...],
-        oid=bo[...],
-        uid=bu[...],
-        count=bc[...],
-        next_seq=bn[...][:, 0],
+    step = jax.vmap(
+        lambda b, a, nb, ns, nq, o: step_rows_impl(config, b, a, nb, ns, nq, o)
     )
-    step = jax.vmap(lambda b, o: step_impl(config, b, o))
 
-    def body(t, books):
+    def body(t, carry):
+        buy, sale, nb, ns, nq = carry
+        slab = ops[pl.ds(t, 1)][0]  # [8, B] in config.dtype
+        # The pack rides in config.dtype (lossless for the value fields; the
+        # three code fields are small ints) — casting the codes back to i32
+        # keeps step semantics identical across dtypes.
         op = DeviceOp(
-            action=action[:, t],
-            side=side[:, t],
-            is_market=ismkt[:, t],
-            price=oprice[:, t],
-            volume=ovol[:, t],
-            oid=ooid[:, t],
-            uid=ouid[:, t],
+            **{
+                f: (
+                    slab[i].astype(jnp.int32)
+                    if f in ("action", "side", "is_market")
+                    else slab[i]
+                )
+                for i, f in enumerate(_OP_FIELDS)
+            }
         )
-        books, out = step(books, op)
-        # fill records [B, K] -> slot t of [B, T, K]
-        for ref, v in (
-            (fp, out.fill_price), (fq, out.fill_qty), (mo, out.maker_oid),
-            (mu, out.maker_uid), (mp, out.maker_prefill),
-            (mr, out.maker_remaining), (ta, out.taker_after),
-        ):
-            ref[:, pl.ds(t, 1), :] = v[:, None, :]
-        # per-op scalars [B] -> slot t of [B, T]
-        for ref, v in (
-            (nf, out.n_fills), (fo, out.fill_overflow),
-            (tr, out.taker_remaining), (rs, out.rested),
-            (bov, out.book_overflow), (cf, out.cancel_found),
-            (cv, out.cancel_volume),
-        ):
-            ref[:, pl.ds(t, 1)] = v[:, None]
-        return books
+        buy, sale, nb, ns, nq, out = step(
+            buy, sale, nb[:, 0], ns[:, 0], nq[:, 0], op
+        )
+        # fill records: [B, K] -> transpose -> slot t of [T, K, B]
+        for ref, f in zip(rec_refs, _REC_FIELDS):
+            ref[pl.ds(t, 1)] = jnp.transpose(getattr(out, f))[None]
+        # per-op scalars: one [8, B] slab (row 7 zero) in config.dtype, so
+        # int64 taker_remaining/cancel_volume survive the pack intact
+        dt = config.dtype
+        s = jnp.stack(
+            [getattr(out, f).astype(dt) for f in _SCALAR_FIELDS]
+            + [jnp.zeros_like(out.n_fills).astype(dt)]
+        )
+        scal[pl.ds(t, 1)] = s[None]
+        return buy, sale, nb[:, None], ns[:, None], nq[:, None]
 
-    books = jax.lax.fori_loop(0, t_len, body, books)
-    op_[...] = books.price
-    ol_[...] = books.lots
-    os_[...] = books.seq
-    oo_[...] = books.oid
-    ou_[...] = books.uid
-    oc_[...] = books.count
-    on_[...] = books.next_seq[:, None]
+    buy, sale, nb, ns, nq = jax.lax.fori_loop(0, t_len, body, carry)
+    for ref, v in zip((ob_p, ob_l, ob_s, ob_o, ob_u), buy):
+        ref[...] = v
+    for ref, v in zip((os_p, os_l, os_s, os_o, os_u), sale):
+        ref[...] = v
+    ocnt[...] = jnp.concatenate([nb, ns], axis=-1)
+    onsq[...] = nq
 
 
 @functools.partial(
@@ -106,90 +137,112 @@ def pallas_batch_step(
     config: BookConfig,
     books: BookState,
     ops: DeviceOp,
-    block_s: int = 8,
+    block_s: int = 128,
     interpret: bool = False,
 ) -> tuple[BookState, StepOutput]:
     """Drop-in replacement for engine.batch.batch_step with identical
     semantics (books [S, ...], ops [S, T] -> books', outs [S, T, ...]).
-    S must be a multiple of block_s (callers pad lanes; NOP rows are free).
+    S must be a multiple of block_s (callers pad lanes; NOP rows are free),
+    and the compiled path needs block_s to be a multiple of 128 (the packed
+    op/record/scalar blocks put the symbol axis on the lane dim).
     """
     s, t_len = ops.action.shape
     if s % block_s != 0:
         raise ValueError(f"S={s} not a multiple of block_s={block_s}")
+    if not interpret and not (block_s % 128 == 0 or block_s == s):
+        # Packed op/record/scalar blocks put the symbol axis on the lane
+        # dim; Mosaic requires lane-dim blocks to be 128-multiples unless
+        # the block spans the full axis.
+        raise ValueError(
+            f"compiled kernel needs block_s % 128 == 0 or block_s == S "
+            f"(got block_s={block_s}, S={s})"
+        )
     cap = config.cap
     k = config.max_fills
-    dt = config.dtype
-    sq = config.seq_dtype
+    dt = jnp.dtype(config.dtype)
+    sq = jnp.dtype(config.seq_dtype)
+    if not interpret and (dt.itemsize > 4 or sq.itemsize > 4):
+        raise ValueError(
+            "compiled pallas kernel is int32-only (no Mosaic 64-bit "
+            "lowering); use the scan path (or interpret=True) for int64"
+        )
     grid = (s // block_s,)
 
     def bspec(*shape):
-        # index_map: block i covers rows [i*block_s, (i+1)*block_s) and the
+        # Symbol-major blocks: block i covers rows [i*block_s, ...) and the
         # full extent of every trailing axis.
         nd = len(shape)
         return pl.BlockSpec(
             (block_s,) + shape, lambda i, _nd=nd: (i,) + (0,) * _nd
         )
 
-    book_specs = [
-        bspec(2, cap), bspec(2, cap), bspec(2, cap), bspec(2, cap),
-        bspec(2, cap), bspec(2), bspec(1),
-    ]
-    op_specs = [bspec(t_len)] * 7
-    out_specs = (
-        book_specs
-        + [bspec(t_len, k)] * 7
-        + [bspec(t_len)] * 7
-    )
-    out_shape = (
-        [
-            jax.ShapeDtypeStruct((s, 2, cap), dt),  # price
-            jax.ShapeDtypeStruct((s, 2, cap), dt),  # lots
-            jax.ShapeDtypeStruct((s, 2, cap), sq),  # seq
-            jax.ShapeDtypeStruct((s, 2, cap), dt),  # oid
-            jax.ShapeDtypeStruct((s, 2, cap), dt),  # uid
-            jax.ShapeDtypeStruct((s, 2), jnp.int32),  # count
-            jax.ShapeDtypeStruct((s, 1), sq),  # next_seq
-        ]
-        + [jax.ShapeDtypeStruct((s, t_len, k), dt)] * 7  # fill records
-        + [
-            jax.ShapeDtypeStruct((s, t_len), jnp.int32),  # n_fills
-            jax.ShapeDtypeStruct((s, t_len), jnp.int32),  # fill_overflow
-            jax.ShapeDtypeStruct((s, t_len), dt),  # taker_remaining
-            jax.ShapeDtypeStruct((s, t_len), jnp.int32),  # rested
-            jax.ShapeDtypeStruct((s, t_len), jnp.int32),  # book_overflow
-            jax.ShapeDtypeStruct((s, t_len), jnp.int32),  # cancel_found
-            jax.ShapeDtypeStruct((s, t_len), dt),  # cancel_volume
-        ]
-    )
+    def tspec(*lead):
+        # Time-leading blocks [*lead, block_s] at block i (dynamic per-step
+        # access lands on the major dim; symbol block rides the lane dim).
+        nd = len(lead)
+        return pl.BlockSpec(
+            lead + (block_s,), lambda i, _nd=nd: (0,) * _nd + (i,)
+        )
 
-    # Alias book inputs to book outputs: the kernel fully overwrites them,
-    # and aliasing lets the runtime reuse the (donated) buffers.
-    aliases = {i: i for i in range(7)}
+    row = lambda dtype: jax.ShapeDtypeStruct((s, cap), dtype)
+    book_specs = [bspec(cap)] * 10 + [bspec(2), bspec(1)]
+    book_shape = (
+        [row(dt), row(dt), row(sq), row(dt), row(dt)] * 2
+        + [
+            jax.ShapeDtypeStruct((s, 2), jnp.int32),
+            jax.ShapeDtypeStruct((s, 1), sq),
+        ]
+    )
+    in_specs = book_specs + [tspec(t_len, 8)]
+    out_specs = book_specs + [tspec(t_len, k)] * 7 + [tspec(t_len, 8)]
+    out_shape = (
+        book_shape
+        + [jax.ShapeDtypeStruct((t_len, k, s), dt)] * 7
+        + [jax.ShapeDtypeStruct((t_len, 8, s), dt)]  # scalar pack
+    )
+    aliases = {i: i for i in range(12)}
+
+    op_pack = jnp.stack(
+        [jnp.transpose(getattr(ops, f).astype(dt)) for f in _OP_FIELDS]
+        + [jnp.zeros((t_len, s), dt)],
+        axis=1,
+    )  # [T, 8, S] in config.dtype (lossless for every field)
+
+    rows_in = [
+        getattr(books, f)[:, side]
+        for side in (0, 1)
+        for f in ("price", "lots", "seq", "oid", "uid")
+    ]
 
     outs = pl.pallas_call(
         functools.partial(_kernel, config, t_len),
         grid=grid,
-        in_specs=book_specs + op_specs,
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         input_output_aliases=aliases,
         interpret=interpret,
-    )(
-        books.price, books.lots, books.seq, books.oid, books.uid,
-        books.count, books.next_seq[:, None],
-        ops.action, ops.side, ops.is_market, ops.price, ops.volume,
-        ops.oid, ops.uid,
-    )
-    (op_, ol_, os_, oo_, ou_, oc_, on_,
-     fp, fq, mo, mu, mp, mr, ta, nf, fo, tr, rs, bov, cf, cv) = outs
+    )(*rows_in, books.count, books.next_seq[:, None], op_pack)
+    (ob_p, ob_l, ob_s, ob_o, ob_u, os_p, os_l, os_s, os_o, os_u,
+     ocnt, onsq, fp, fq, mo, mu, mp, mr, ta, scal) = outs
+
+    pair = lambda b, a: jnp.stack([b, a], axis=1)  # [S, cap] x2 -> [S, 2, cap]
     new_books = BookState(
-        price=op_, lots=ol_, seq=os_, oid=oo_, uid=ou_,
-        count=oc_, next_seq=on_[:, 0],
+        price=pair(ob_p, os_p),
+        lots=pair(ob_l, os_l),
+        seq=pair(ob_s, os_s),
+        oid=pair(ob_o, os_o),
+        uid=pair(ob_u, os_u),
+        count=ocnt,
+        next_seq=onsq[:, 0],
     )
-    out = StepOutput(
-        fill_price=fp, fill_qty=fq, maker_oid=mo, maker_uid=mu,
-        maker_prefill=mp, maker_remaining=mr, taker_after=ta,
-        n_fills=nf, fill_overflow=fo, taker_remaining=tr, rested=rs,
-        book_overflow=bov, cancel_found=cf, cancel_volume=cv,
-    )
+    sca = jnp.transpose(scal, (2, 0, 1))  # [T, 8, S] -> [S, T, 8]
+    fields = {
+        f: jnp.transpose(r, (2, 0, 1))  # [T, K, S] -> [S, T, K]
+        for f, r in zip(_REC_FIELDS, (fp, fq, mo, mu, mp, mr, ta))
+    }
+    for i, f in enumerate(_SCALAR_FIELDS):
+        want = dt if f in ("taker_remaining", "cancel_volume") else jnp.int32
+        fields[f] = sca[..., i].astype(want)
+    out = StepOutput(**fields)
     return new_books, out

@@ -75,7 +75,8 @@ def test_batch_engine_pallas_kernel_oracle_parity():
         expected.extend(oracle.process(o))
 
     engine = BatchEngine(
-        BookConfig(cap=32, max_fills=8), n_slots=8, max_t=16, kernel="pallas"
+        BookConfig(cap=32, max_fills=8), n_slots=8, max_t=16,
+        kernel="pallas", pallas_interpret=True,
     )
     got = []
     for i in range(0, len(orders), 40):
