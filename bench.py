@@ -109,11 +109,12 @@ def main():
         from gome_tpu.ops import pallas_available, pallas_batch_step
 
         interp = not pallas_available(config.dtype)
-        block_s = int(
-            os.environ.get(
-                "BENCH_BLOCK_S", next(b for b in (128, 8, 1) if S % b == 0)
-            )
-        )
+        # Compiled-kernel blocking rule: 128-multiples or one whole-axis
+        # block; interpret mode (CPU check) has no constraint.
+        default_block = (
+            128 if S % 128 == 0 else S
+        ) if not interp else next(b for b in (128, 8, 1) if S % b == 0)
+        block_s = int(os.environ.get("BENCH_BLOCK_S", default_block))
         stepper = jax.jit(
             lambda books, ops: pallas_batch_step(
                 config, books, ops, block_s=block_s, interpret=interp
@@ -135,10 +136,11 @@ def main():
         lambda o: jnp.stack([jnp.sum(o.n_fills), jnp.sum(o.book_overflow)])
     )
     add = jax.jit(lambda a, b: a + b)
-    # Device accumulators are int32 when x64 is off; flushing to host Python
-    # ints every FLUSH_EVERY grids keeps the on-device partial far from 2^31
-    # at any run length (per-grid fills <= S*T*K < 2^31).
-    FLUSH_EVERY = 256
+    # Device accumulators are int32 when x64 is off; flush to host Python
+    # ints often enough that the on-device partial stays under 2^31 for ANY
+    # grid geometry (per-grid fills <= S*T*max_fills).
+    per_grid_max = S * T * config.max_fills
+    FLUSH_EVERY = max(1, min(256, (2**31 - 1) // max(per_grid_max, 1)))
 
     books = init_books(config, S)
     np_dtype = np.int32 if DTYPE == "int32" else np.int64

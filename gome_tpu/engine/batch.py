@@ -233,7 +233,11 @@ class BatchEngine:
                 self.stats.fills += 1
         return events
 
-    def _one_grid(self, pending, decoded):
+    def _pack_grid(self, pending):
+        """Pack a pending (arrival, order) list into one [S, max_t] op grid.
+        Returns (ops, contexts, leftover): contexts maps (lane, t) -> the
+        packed (arrival, order); leftover holds deferred ops from lanes
+        whose time axis filled (FIFO within a symbol is never split)."""
         # Resolve lanes first (this may auto-grow the book stack), so the
         # grid is allocated once at the final lane count and newly created
         # lanes pack into THIS grid rather than deferring to an extra
@@ -258,8 +262,153 @@ class BatchEngine:
                 arr[lane, t] = getattr(op, name)
             contexts[(lane, t)] = (arrival, order)
             fill_level[lane] = t + 1
+        return DeviceOp(**grid), contexts, leftover
 
-        ops = DeviceOp(**{k: v for k, v in grid.items()})
+    def process_columnar(self, orders: list[Order]):
+        """Apply a micro-batch and return events as a columnar EventBatch
+        (gome_tpu.engine.events) instead of MatchResult objects — the
+        vectorized decode path that keeps the host in step with the device
+        kernel's throughput. Identical event content and global order to
+        process(); stats are updated the same way."""
+        from .events import EventBatch, empty_batch
+
+        pending = [(i, o) for i, o in enumerate(orders)]
+        dels = sum(1 for o in orders if o.action is Action.DEL)
+        batches: list[EventBatch] = []
+        while pending:
+            pending = self._one_grid_columnar(pending, batches)
+        self.stats.orders += len(orders)
+
+        tables = dict(
+            symbols=self.symbols.to_list(),
+            oid_table=self.oids.table,
+            uid_table=self.uids.table,
+        )
+        if not batches:
+            return empty_batch(**tables)
+        cols = {
+            n: np.concatenate([b.columns[n] for b in batches])
+            for n in batches[0].columns
+        }
+        # Leftover grids hold deferred ops whose arrivals interleave with
+        # the first grid's: restore the global emission order.
+        order_ix = np.argsort(cols["arrival"], kind="stable")
+        cols = {n: v[order_ix] for n, v in cols.items()}
+        batch = EventBatch(columns=cols, **tables)
+        cancels = int(batch.columns["is_cancel"].sum())
+        self.stats.cancels += cancels
+        self.stats.fills += len(batch) - cancels
+        self.stats.cancels_missed += dels - cancels
+        return batch
+
+    def _pack_grid_vectorized(self, pending):
+        """Columnar-path packing: one Python pass extracts per-op fields into
+        a [N, 8] int table; lane/slot assignment and the grid writes are
+        numpy scatters. ~10x cheaper per op than _pack_grid's per-field
+        scalar stores (the decode side is vectorized too, so packing would
+        otherwise dominate the host budget)."""
+        from ..types import OrderType
+
+        n = len(pending)
+        lanes = np.fromiter(
+            (self._lane(o.symbol) for _, o in pending), np.int64, n
+        )
+        # Slot within the lane = occurrence index (FIFO by construction:
+        # occurrence order == arrival order, and every op past max_t defers,
+        # so a lane's stream never reorders or splits across grids).
+        t = np.zeros(n, np.int64)
+        level: dict[int, int] = {}
+        for i, lane in enumerate(lanes):
+            c = level.get(lane, 0)
+            t[i] = c
+            level[lane] = c + 1
+        packed = t < self.max_t
+
+        oids, uids = self.oids, self.uids
+        table = np.empty((n, 7), np.int64)
+        for i, (_, o) in enumerate(pending):
+            row = table[i]
+            row[0] = int(o.action)
+            row[1] = int(o.side)
+            row[2] = o.order_type is OrderType.MARKET
+            row[3] = o.price
+            row[4] = o.volume
+            row[5] = oids.intern(o.oid)
+            row[6] = uids.intern(o.uuid)
+        bad = packed & (table[:, 0] == int(Action.ADD)) & (table[:, 4] <= 0)
+        if bad.any():
+            i = int(np.nonzero(bad)[0][0])
+            raise ValueError(
+                f"volume must be positive, got {table[i, 4]} "
+                f"(oid={pending[i][1].oid}); volume<=0 is out of contract"
+            )
+
+        grid = _nop_grid(self.config, self.n_slots, self.max_t)
+        pl, pt = lanes[packed], t[packed]
+        for col, name in enumerate(
+            ("action", "side", "is_market", "price", "volume", "oid", "uid")
+        ):
+            grid[name][pl, pt] = table[packed, col]
+        meta = {
+            "lane": pl,
+            "t": pt,
+            "arrival": np.fromiter(
+                (a for (a, _), p in zip(pending, packed) if p),
+                np.int64,
+            ),
+            "action": table[packed, 0],
+            "side": table[packed, 1],
+            "is_market": table[packed, 2],
+            "price": table[packed, 3],
+            "oid_id": table[packed, 5],
+            "uid_id": table[packed, 6],
+        }
+        leftover = [pending[i] for i in np.nonzero(~packed)[0]]
+        return DeviceOp(**grid), meta, leftover
+
+    def _one_grid_columnar(self, pending, batches):
+        from .events import decode_grid_columnar
+
+        ops, meta, leftover = self._pack_grid_vectorized(pending)
+        # _run_exact keys escalation bookkeeping by (lane, t); give it the
+        # packed coordinates.
+        contexts = {
+            (int(l), int(tt)): None for l, tt in zip(meta["lane"], meta["t"])
+        }
+        outs, lane_overrides = self._run_exact(ops, contexts)
+
+        def outs_at(field, lanes, ts):
+            base = np.asarray(getattr(outs, field))[lanes, ts]
+            for lane, src in lane_overrides.items():
+                m = lanes == lane
+                if not m.any():
+                    continue
+                ov = np.asarray(getattr(src, field))[ts[m]]
+                if base.ndim > 1:
+                    # Each escalated lane carries its own record budget K';
+                    # pad whichever side is narrower (two escalated lanes in
+                    # one grid can have different K').
+                    k_base, k_ov = base.shape[1], ov.shape[1]
+                    if k_ov > k_base:
+                        base = np.pad(base, [(0, 0), (0, k_ov - k_base)])
+                    elif k_ov < k_base:
+                        ov = np.pad(ov, [(0, 0), (0, k_base - k_ov)])
+                base[m] = ov
+            return base
+
+        batches.append(
+            decode_grid_columnar(
+                meta,
+                outs_at,
+                symbols=self.symbols.to_list(),
+                oid_table=self.oids.table,
+                uid_table=self.uids.table,
+            )
+        )
+        return leftover
+
+    def _one_grid(self, pending, decoded):
+        ops, contexts, leftover = self._pack_grid(pending)
         outs, lane_overrides = self._run_exact(ops, contexts)
         for (lane, t), (arrival, order) in contexts.items():
             src = lane_overrides.get(lane)
@@ -350,8 +499,9 @@ class BatchEngine:
 
             s = ops.action.shape[0]
             # Lane-dim blocking rule of the compiled kernel: 128-multiples,
-            # or one block spanning the whole axis.
-            block_s = 128 if s % 128 == 0 else (s if s <= 128 else None)
+            # or one block spanning the whole axis (VMEM-bounded: a single
+            # whole-axis block only fits for modest lane counts).
+            block_s = 128 if s % 128 == 0 else (s if s <= 256 else None)
             if self._pallas_interpret and block_s is None:
                 block_s = next(b for b in (8, 1) if s % b == 0)
             if block_s is not None and (
@@ -396,6 +546,12 @@ class BatchEngine:
             max_fills=int(state["max_fills"]),
             dtype=jnp.dtype(state["dtype"]),
         )
+        # Restoring an int64 snapshot into a process that never built an
+        # int64 book would silently device_put int32 arrays (x64 off) —
+        # the exact failure ensure_dtype_usable exists to prevent.
+        from .book import ensure_dtype_usable
+
+        ensure_dtype_usable(self.config.dtype)
         self.n_slots = int(state["n_slots"])
         self.max_t = int(state["max_t"])
         b = state["books"]

@@ -114,7 +114,25 @@ class StepOutput(NamedTuple):
     cancel_volume: jax.Array  # lots remaining at cancel (engine.go:100)
 
 
+def ensure_dtype_usable(dtype) -> None:
+    """int64 books silently degrade to int32 when jax's x64 mode is off —
+    wrong matching arithmetic (depth prefix sums overflow), not an error.
+    Enable x64 on the user's behalf (with a warning, since it is global
+    config) rather than let that happen."""
+    if jnp.dtype(dtype).itemsize == 8 and not jax.config.jax_enable_x64:
+        import warnings
+
+        warnings.warn(
+            "BookConfig dtype is 64-bit but jax_enable_x64 is off; enabling "
+            "it globally (set JAX_ENABLE_X64=1 or use an int32 BookConfig "
+            "to silence this)",
+            stacklevel=3,
+        )
+        jax.config.update("jax_enable_x64", True)
+
+
 def init_book(config: BookConfig) -> BookState:
+    ensure_dtype_usable(config.dtype)
     shape = (2, config.cap)
     z = jnp.zeros(shape, config.dtype)
     return BookState(

@@ -42,6 +42,12 @@ class Interner:
     def lookup(self, i: int) -> str:
         return self._to_str[i]
 
+    @property
+    def table(self) -> list[str]:
+        """id -> string table including the reserved "" at id 0 (the shape
+        columnar decode indexes by raw interner id)."""
+        return self._to_str
+
     def __len__(self) -> int:
         return len(self._to_str)
 
