@@ -67,6 +67,76 @@ def build_grids(s, t, g, seed=0, dtype=np.int64):
     return grids
 
 
+def build_config_grids(cfg, s, t, g, seed=0, dtype=np.int64):
+    """BASELINE.json config shapes 1-5 (BENCH_CONFIG); grids are NOP-padded
+    where a shape leaves slots idle (the caller counts action != 0 for its
+    throughput denominator). The default bench path is build_grids: uniform
+    full grids, the exchange-scale config-4 shape at peak device utilization.
+
+      1  single-symbol limit cross (BUY sweeps resting asks; S=1 lane live)
+      2  single-symbol mixed stream with partial fills + cancels
+      3  100-symbol Poisson flow (only lanes 0..99 live, Poisson thinning)
+      4  Zipf-skewed per-symbol arrival rates across all S lanes
+      5  market + limit mix with multi-level depth-walk fills
+    """
+    rng = np.random.default_rng(seed)
+    grids = []
+    oid_base = 1
+    for _ in range(g):
+        d = dict(
+            action=np.zeros((s, t), np.int32),
+            side=np.zeros((s, t), np.int32),
+            is_market=np.zeros((s, t), np.int32),
+            price=np.zeros((s, t), dtype),
+            volume=np.zeros((s, t), dtype),
+            oid=np.zeros((s, t), dtype),
+            uid=np.ones((s, t), dtype),
+        )
+        if cfg in (1, 2):
+            live = np.zeros(s, bool)
+            live[0] = True
+            mask = np.zeros((s, t), bool)
+            mask[0, :] = True
+        elif cfg == 3:
+            lanes = min(100, s)
+            mask = np.zeros((s, t), bool)
+            mask[:lanes] = rng.random((lanes, t)) < 0.7  # Poisson thinning
+        elif cfg == 4:
+            ranks = np.arange(1, s + 1, dtype=np.float64)
+            p_live = np.minimum(1.0, (1.0 / ranks) * 8)  # Zipf(1) rates
+            mask = rng.random((s, t)) < p_live[:, None]
+        else:  # 5
+            mask = np.ones((s, t), bool)
+        n = int(mask.sum())
+        d["action"][mask] = 1
+        d["side"][mask] = rng.integers(0, 2, n)
+        if cfg == 1:
+            # alternate resting asks and sweeping bids on the one lane
+            tt = np.arange(t)
+            d["side"][0] = (tt % 2 == 0).astype(np.int32)  # even: SALE rests
+            d["price"][0] = np.where(
+                tt % 2 == 0, 100_000_000 + (tt % 8) * 1000, 101_000_000
+            )
+            d["volume"][0] = np.where(tt % 2 == 0, 5_000_000, 12_000_000)
+        else:
+            d["price"][mask] = rng.integers(99_500_000, 100_500_000, n)
+            d["volume"][mask] = rng.integers(1, 101, n) * 1_000_000
+        if cfg in (2, 5):
+            # ~15% cancels of random earlier oids (misses allowed — the
+            # reference's DeleteOrder on a filled order returns false)
+            cm = mask & (rng.random((s, t)) < 0.15)
+            d["action"][cm] = 2
+            d["oid"][cm] = rng.integers(1, max(oid_base, 2), int(cm.sum()))
+        if cfg == 5:
+            mm = mask & (rng.random((s, t)) < 0.25) & (d["action"] == 1)
+            d["is_market"][mm] = 1
+        fresh = d["action"] == 1
+        d["oid"][fresh] = oid_base + np.arange(int(fresh.sum()))
+        oid_base += int(fresh.sum())
+        grids.append(d)
+    return grids
+
+
 def main():
     check = "--check" in sys.argv
     DTYPE = os.environ.get("BENCH_DTYPE", "int32")  # int64 | int32
@@ -89,7 +159,13 @@ def main():
     from gome_tpu.engine import BookConfig, batch_step, init_books
     from gome_tpu.engine.book import DeviceOp
 
-    S = int(os.environ.get("BENCH_SYMBOLS", 64 if check else 10240))
+    CFG = os.environ.get("BENCH_CONFIG", "")  # "", or "1".."5"
+    # Each BASELINE config has a natural symbol count: sizing the lane axis
+    # to the live symbols keeps the measurement about the flow shape, not
+    # about dispatching a mostly-NOP grid (overridable via BENCH_SYMBOLS).
+    cfg_symbols = {"1": 8, "2": 8, "3": 128}
+    default_s = 64 if check else cfg_symbols.get(CFG, 10240)
+    S = int(os.environ.get("BENCH_SYMBOLS", default_s))
     T = int(os.environ.get("BENCH_T", 4 if check else 16))
     G = int(os.environ.get("BENCH_GRIDS", 2 if check else 48))
     CAP = int(os.environ.get("BENCH_CAP", 32 if check else 256))
@@ -110,11 +186,29 @@ def main():
 
         interp = not pallas_available(config.dtype)
         # Compiled-kernel blocking rule: 128-multiples or one whole-axis
-        # block; interpret mode (CPU check) has no constraint.
-        default_block = (
-            128 if S % 128 == 0 else S
-        ) if not interp else next(b for b in (128, 8, 1) if S % b == 0)
-        block_s = int(os.environ.get("BENCH_BLOCK_S", default_block))
+        # block (VMEM-bounded, so only for modest S — same policy as
+        # BatchEngine); interpret mode (CPU check) has no constraint.
+        if interp:
+            default_block = next(b for b in (128, 8, 1) if S % b == 0)
+        elif S % 128 == 0:
+            default_block = 128
+        elif S <= 256:
+            default_block = S
+        else:
+            print(
+                f"# NOTE: S={S} has no valid compiled-kernel blocking; "
+                "falling back to the scan kernel",
+                file=sys.stderr,
+            )
+            default_block = None
+        block_s = (
+            int(os.environ["BENCH_BLOCK_S"])
+            if "BENCH_BLOCK_S" in os.environ
+            else default_block
+        )
+    if KERNEL == "pallas" and block_s is None:
+        KERNEL = "scan"
+    if KERNEL == "pallas":
         stepper = jax.jit(
             lambda books, ops: pallas_batch_step(
                 config, books, ops, block_s=block_s, interpret=interp
@@ -144,7 +238,13 @@ def main():
 
     books = init_books(config, S)
     np_dtype = np.int32 if DTYPE == "int32" else np.int64
-    raw = build_grids(S, T, G + 2, dtype=np_dtype)
+    if CFG:
+        raw = build_config_grids(int(CFG), S, T, G + 2, dtype=np_dtype)
+        # warmup consumes 2 grids; count only the timed ones
+        timed_orders = sum(int((d["action"] != 0).sum()) for d in raw[2:])
+    else:
+        raw = build_grids(S, T, G + 2, dtype=np_dtype)
+        timed_orders = S * T * G
     if DTYPE == "int32":
         # int32 mode uses coarser lot units so per-side depth totals stay
         # far from 2^31 (the documented int32-mode operating contract).
@@ -191,10 +291,11 @@ def main():
             "BENCH_CAP for an honest run",
             file=sys.stderr,
         )
-    orders = S * T * G
+    orders = timed_orders
     throughput = orders / elapsed
+    cfg_tag = f", config {CFG}" if CFG else ""
     result = {
-        "metric": f"device matching throughput, {S} symbols x {T}-deep grids, cap={CAP}, {DTYPE} ticks, {KERNEL} kernel",
+        "metric": f"device matching throughput, {S} symbols x {T}-deep grids, cap={CAP}, {DTYPE} ticks, {KERNEL} kernel{cfg_tag}",
         "value": round(throughput),
         "unit": "orders/sec",
         "vs_baseline": round(throughput / 1_000_000, 3),

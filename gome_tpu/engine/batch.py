@@ -274,7 +274,7 @@ class BatchEngine:
 
         pending = [(i, o) for i, o in enumerate(orders)]
         dels = sum(1 for o in orders if o.action is Action.DEL)
-        batches: list[EventBatch] = []
+        batches: list[dict] = []  # per-grid column dicts
         while pending:
             pending = self._one_grid_columnar(pending, batches)
         self.stats.orders += len(orders)
@@ -287,8 +287,7 @@ class BatchEngine:
         if not batches:
             return empty_batch(**tables)
         cols = {
-            n: np.concatenate([b.columns[n] for b in batches])
-            for n in batches[0].columns
+            n: np.concatenate([b[n] for b in batches]) for n in batches[0]
         }
         # Leftover grids hold deferred ops whose arrivals interleave with
         # the first grid's: restore the global emission order.
@@ -396,15 +395,7 @@ class BatchEngine:
                 base[m] = ov
             return base
 
-        batches.append(
-            decode_grid_columnar(
-                meta,
-                outs_at,
-                symbols=self.symbols.to_list(),
-                oid_table=self.oids.table,
-                uid_table=self.uids.table,
-            )
-        )
+        batches.append(decode_grid_columnar(meta, outs_at))
         return leftover
 
     def _one_grid(self, pending, decoded):

@@ -112,14 +112,21 @@ class EventBatch:
 
     def to_json_lines(self) -> list[bytes]:
         """Wire-shape serialization straight from columns — byte-identical
-        to bus.codec.encode_match_result for every event. String fields are
-        JSON-escaped once per interner table entry, not once per event."""
+        to bus.codec.encode_match_result for every event. Only the ids this
+        batch references are JSON-escaped (the interner tables grow without
+        bound over a process lifetime; escaping whole tables per batch would
+        be quadratic on the consumer hot path)."""
         import json
 
         c = self.columns
-        esc = lambda table: [json.dumps(s) for s in table]
-        oid_t, uid_t = esc(self.oid_table), esc(self.uid_table)
-        syms = esc(list(self.symbols))
+
+        def esc(table, *id_cols):
+            ids = np.unique(np.concatenate([c[n] for n in id_cols])) if id_cols else []
+            return {int(i): json.dumps(table[int(i)]) for i in ids}
+
+        oid_t = esc(self.oid_table, "taker_oid", "maker_oid")
+        uid_t = esc(self.uid_table, "taker_uid", "maker_uid")
+        syms = esc(list(self.symbols), "symbol_id")
         lines = []
         for i in range(len(self)):
             symbol = syms[c["symbol_id"][i]]
@@ -162,14 +169,10 @@ def empty_batch(symbols, oid_table, uid_table) -> EventBatch:
     )
 
 
-def decode_grid_columnar(
-    ops_meta: dict,
-    outs_at,
-    symbols: list[str],
-    oid_table: list[str],
-    uid_table: list[str],
-) -> EventBatch:
-    """Vectorized decode of one grid's worth of op results.
+def decode_grid_columnar(ops_meta: dict, outs_at) -> dict[str, np.ndarray]:
+    """Vectorized decode of one grid's worth of op results into raw event
+    columns (no tables attached — the caller assembles the final EventBatch
+    once per micro-batch, not per grid).
 
     ops_meta: parallel numpy arrays describing the ops that were packed into
     the grid — lane, t, arrival, side, price, is_market, action, oid_id,
@@ -178,7 +181,7 @@ def decode_grid_columnar(
     (lane, t) coordinates ([N] or [N, K]); indirection so the caller can
     splice in per-lane escalation re-runs.
 
-    Returns events sorted by (arrival, fill index) — the reference's global
+    Returns columns sorted by (arrival, fill index) — the reference's global
     emission order.
     """
     lane = ops_meta["lane"]
@@ -250,10 +253,4 @@ def decode_grid_columnar(
     # (np.nonzero already yields row-major = record order; a stable sort on
     # arrival preserves it).
     order = np.argsort(columns["arrival"], kind="stable")
-    columns = {n: v[order] for n, v in columns.items()}
-    return EventBatch(
-        columns=columns,
-        symbols=symbols,
-        oid_table=oid_table,
-        uid_table=uid_table,
-    )
+    return {n: v[order] for n, v in columns.items()}

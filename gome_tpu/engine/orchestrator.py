@@ -63,14 +63,27 @@ class MatchEngine:
     # -- consumer side -----------------------------------------------------
     def process(self, orders: list[Order]) -> list[MatchResult]:
         """Apply one micro-batch in arrival order; returns the MatchResult
-        event stream in the reference's global emission order."""
+        event stream in the reference's global emission order. Admission
+        (the pre-pool check, engine.go:58-62) drops ADDs cancelled before
+        consumption without touching the book."""
+        return self.batch.process(self._admit(orders))
+
+    def process_one(self, order: Order) -> list[MatchResult]:
+        return self.process([order])
+
+    def process_columnar(self, orders: list[Order]):
+        """process() with the vectorized decode path: same admission, same
+        event content/order, but returns a columnar EventBatch
+        (gome_tpu.engine.events) — the shape the consumer publishes from
+        without building per-event objects."""
+        return self.batch.process_columnar(self._admit(orders))
+
+    def _admit(self, orders: list[Order]) -> list[Order]:
         admitted: list[Order] = []
         for order in orders:
             if order.action is Action.ADD:
                 key = self._prekey(order)
                 if key not in self.pre_pool:
-                    # Cancelled (or never marked) before consumption: drop
-                    # without touching the book (engine.go:58-62).
                     self.stats.dropped_no_prepool += 1
                     continue
                 self.pre_pool.discard(key)
@@ -79,10 +92,7 @@ class MatchEngine:
                 self.pre_pool.discard(self._prekey(order))
                 admitted.append(order)
             # NOP padding never reaches the device.
-        return self.batch.process(admitted)
-
-    def process_one(self, order: Order) -> list[MatchResult]:
-        return self.process([order])
+        return admitted
 
     # -- views -------------------------------------------------------------
     @property

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 
-from ..bus import QueueBus, decode_order, encode_match_result
+from ..bus import QueueBus, decode_order
 from ..engine.orchestrator import MatchEngine
 from ..utils.logging import get_logger
 from ..utils.metrics import REGISTRY
@@ -68,24 +68,26 @@ class OrderConsumer:
             with annotate("decode_orders"):
                 orders = [decode_order(m.body) for m in msgs]
             with annotate("engine_process"):
-                events = self.engine.process(orders)
+                # Columnar path end to end: events stay as numpy columns
+                # from decode through wire serialization; no per-event
+                # Python objects on the hot path (engine/events.py).
+                batch = self.engine.process_columnar(orders)
             with annotate("publish_events"):
                 # one write+fsync for the whole batch on the native backend
-                self.bus.match_queue.publish_batch(
-                    [encode_match_result(ev) for ev in events]
-                )
+                self.bus.match_queue.publish_batch(batch.to_json_lines())
+            n_events = len(batch)
             # Commit only after results are published: a crash between
             # processing and commit replays the batch (at-least-once;
             # recovery dedup lives in gome_tpu.persist's replay logic).
             self.bus.order_queue.commit(msgs[-1].offset + 1)
         _orders_total.inc(len(orders))
-        _events_total.inc(len(events))
+        _events_total.inc(n_events)
         _batch_size.observe(len(orders))
         if timer.elapsed > 0:
             inst = len(orders) / timer.elapsed
             _throughput.set(0.8 * _throughput.value() + 0.2 * inst)
         if self.on_batch is not None:
-            self.on_batch(len(orders), len(events))
+            self.on_batch(len(orders), n_events)
         return len(orders)
 
     def drain(self) -> int:

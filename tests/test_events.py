@@ -108,6 +108,28 @@ def test_json_lines_byte_parity_with_codec():
     assert batch.to_json_lines() == expected
 
 
+def test_orchestrator_columnar_admission_parity():
+    """MatchEngine.process_columnar applies the same pre-pool admission as
+    process (ADD dropped when cancelled-before-consume)."""
+    from gome_tpu.engine.orchestrator import MatchEngine
+    from gome_tpu.types import Action, Order, Side
+
+    mk = lambda: MatchEngine(BookConfig(cap=32, max_fills=4), n_slots=4)
+    a, b = mk(), mk()
+    orders = mixed_stream(n=120, seed=3, cancel_prob=0.2)
+    for e in (a, b):
+        for o in orders:
+            e.mark(o)
+    # cancel-before-consume: unmark one ADD before processing
+    victim = next(o for o in orders if o.action is Action.ADD)
+    for e in (a, b):
+        e.pre_pool.discard((victim.symbol, victim.uuid, victim.oid))
+    obj = a.process(orders)
+    col = b.process_columnar(orders).to_results()
+    assert obj == col
+    assert a.stats.dropped_no_prepool == b.stats.dropped_no_prepool == 1
+
+
 def test_empty_batch():
     engine = BatchEngine(BookConfig(cap=16, max_fills=4), n_slots=2)
     batch = engine.process_columnar([])
