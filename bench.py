@@ -93,8 +93,6 @@ def build_config_grids(cfg, s, t, g, seed=0, dtype=np.int64):
             uid=np.ones((s, t), dtype),
         )
         if cfg in (1, 2):
-            live = np.zeros(s, bool)
-            live[0] = True
             mask = np.zeros((s, t), bool)
             mask[0, :] = True
         elif cfg == 3:

@@ -193,6 +193,97 @@ class BatchEngine:
         self.oids = Interner()
         self.uids = Interner()
         self.stats = EngineStats()
+        # Price rebasing (32-bit books only): device prices are stored
+        # relative to a per-lane int64 base, so absolute tick magnitudes are
+        # unbounded while each symbol's ACTIVE window is +-2^31 ticks — the
+        # windowed-ladder re-centering of SURVEY §5.7, done at the host
+        # boundary where it costs one subtract. int64 books keep base 0.
+        self._rebase = jnp.dtype(config.dtype).itemsize <= 4
+        self.price_base = np.zeros(n_slots, np.int64)
+        self._base_set = np.zeros(n_slots, bool)
+        # Conservative absolute-price envelope per lane (grows only): the
+        # recenter check proves every price the lane has EVER admitted still
+        # fits the int32 window under a new base, without a device scan.
+        self._env_lo = np.zeros(n_slots, np.int64)
+        self._env_hi = np.zeros(n_slots, np.int64)
+
+    # Admission window around the current base; recenter when exceeded.
+    REBASE_LIMIT = 1 << 30
+    _INT32_SAFE = (1 << 31) - 2
+
+    def _grow_base_arrays(self, new_slots: int) -> None:
+        pad = new_slots - len(self.price_base)
+        self.price_base = np.pad(self.price_base, (0, pad))
+        self._base_set = np.pad(self._base_set, (0, pad))
+        self._env_lo = np.pad(self._env_lo, (0, pad))
+        self._env_hi = np.pad(self._env_hi, (0, pad))
+
+    def _prepare_bases(self, pending, lanes) -> None:
+        """Set / recenter per-lane price bases so every price in `pending`
+        is representable on device. Runs before packing; recentering shifts
+        the lane's resting prices on device (rare — only when flow drifts
+        more than REBASE_LIMIT ticks from the current base)."""
+        if not self._rebase:
+            return
+        from ..types import OrderType
+
+        lo: dict[int, int] = {}
+        hi: dict[int, int] = {}
+        for (_, o), lane in zip(pending, lanes):
+            if o.order_type is OrderType.MARKET:
+                # Price is documented-ignored for MARKET (types.py): it must
+                # not poison the lane's price envelope (a Price:0 market
+                # order would otherwise widen it past the int32 window
+                # forever). encode zeroes the device price too.
+                continue
+            p = o.price
+            l = lo.get(lane)
+            if l is None:
+                lo[lane] = hi[lane] = p
+            else:
+                if p < l:
+                    lo[lane] = p
+                elif p > hi[lane]:
+                    hi[lane] = p
+        for lane, l in lo.items():
+            h = hi[lane]
+            if not self._base_set[lane]:
+                nb = (l + h) // 2
+                if max(h - nb, nb - l) > self._INT32_SAFE:
+                    raise CapacityError(
+                        f"lane {lane}: batch price range [{l}, {h}] spans "
+                        "more than 2^31 ticks — int32 books cannot window "
+                        "it; use coarser ticks or an int64 BookConfig"
+                    )
+                self.price_base[lane] = nb
+                self._base_set[lane] = True
+                self._env_lo[lane] = l
+                self._env_hi[lane] = h
+                continue
+            self._env_lo[lane] = min(self._env_lo[lane], l)
+            self._env_hi[lane] = max(self._env_hi[lane], h)
+            b = int(self.price_base[lane])
+            if max(abs(l - b), abs(h - b)) <= self.REBASE_LIMIT:
+                continue
+            el, eh = int(self._env_lo[lane]), int(self._env_hi[lane])
+            nb = (el + eh) // 2
+            if max(eh - nb, nb - el) > self._INT32_SAFE:
+                raise CapacityError(
+                    f"lane {lane}: admitted price range [{el}, {eh}] spans "
+                    "more than 2^31 ticks — int32 books cannot window it; "
+                    "use coarser ticks or an int64 BookConfig"
+                )
+            self._shift_lane_prices(lane, b - nb)
+            self.price_base[lane] = nb
+
+    def _shift_lane_prices(self, lane: int, delta: int) -> None:
+        """Recenter: stored rebased price -> absolute - new_base =
+        stored + (old_base - new_base). Inactive slots shift too, harmlessly
+        (matching masks everything beyond count; inserts overwrite)."""
+        d = jnp.asarray(delta, self.config.dtype)
+        self.books = self.books._replace(
+            price=self.books.price.at[lane].add(d)
+        )
 
     def _lane(self, symbol: str) -> int:
         lane = self.symbols.intern(symbol) - 1  # Interner ids start at 1
@@ -210,6 +301,7 @@ class BatchEngine:
                     "across more engines"
                 )
             self.books = grow_lanes(self.books, new_slots)
+            self._grow_base_arrays(new_slots)
             self.n_slots = new_slots
             self.stats.lane_growths += 1
         return lane
@@ -243,6 +335,7 @@ class BatchEngine:
         # lanes pack into THIS grid rather than deferring to an extra
         # device call.
         lanes = [self._lane(order.symbol) for _, order in pending]
+        self._prepare_bases(pending, lanes)
         grid = _nop_grid(self.config, self.n_slots, self.max_t)
         contexts: dict[tuple[int, int], tuple[int, Order]] = {}
         fill_level: dict[int, int] = {}
@@ -257,7 +350,13 @@ class BatchEngine:
                 blocked.add(lane)
                 leftover.append((arrival, order))
                 continue
-            op = encode_op(order, self.oids, self.uids, self.config.dtype)
+            op = encode_op(
+                order,
+                self.oids,
+                self.uids,
+                self.config.dtype,
+                price_base=int(self.price_base[lane]),
+            )
             for name, arr in grid.items():
                 arr[lane, t] = getattr(op, name)
             contexts[(lane, t)] = (arrival, order)
@@ -312,6 +411,8 @@ class BatchEngine:
         lanes = np.fromiter(
             (self._lane(o.symbol) for _, o in pending), np.int64, n
         )
+        self._prepare_bases(pending, lanes)
+        bases = self.price_base[lanes]  # [N] int64
         # Slot within the lane = occurrence index (FIFO by construction:
         # occurrence order == arrival order, and every op past max_t defers,
         # so a lane's stream never reorders or splits across grids).
@@ -334,20 +435,40 @@ class BatchEngine:
             row[4] = o.volume
             row[5] = oids.intern(o.oid)
             row[6] = uids.intern(o.uuid)
-        bad = packed & (table[:, 0] == int(Action.ADD)) & (table[:, 4] <= 0)
+        adds = packed & (table[:, 0] == int(Action.ADD))
+        bad = adds & (table[:, 4] <= 0)
         if bad.any():
             i = int(np.nonzero(bad)[0][0])
             raise ValueError(
                 f"volume must be positive, got {table[i, 4]} "
                 f"(oid={pending[i][1].oid}); volume<=0 is out of contract"
             )
+        if np.dtype(self.config.dtype).itemsize <= 4:
+            from .step import LOT_MAX32
+
+            over = adds & (table[:, 4] > LOT_MAX32)
+            if over.any():
+                i = int(np.nonzero(over)[0][0])
+                raise ValueError(
+                    f"volume {table[i, 4]} exceeds the int32-mode per-order "
+                    f"lot ceiling {LOT_MAX32} (oid={pending[i][1].oid}); "
+                    "use coarser lot units or an int64 BookConfig"
+                )
 
         grid = _nop_grid(self.config, self.n_slots, self.max_t)
         pl, pt = lanes[packed], t[packed]
         for col, name in enumerate(
             ("action", "side", "is_market", "price", "volume", "oid", "uid")
         ):
-            grid[name][pl, pt] = table[packed, col]
+            vals = table[packed, col]
+            if name == "price":
+                # Device sees rebased ticks; MARKET prices are documented-
+                # ignored and encode as 0 (they are excluded from the
+                # envelope, so rebasing them could overflow).
+                vals = np.where(
+                    table[packed, 2] != 0, 0, vals - bases[packed]
+                )
+            grid[name][pl, pt] = vals
         meta = {
             "lane": pl,
             "t": pt,
@@ -358,7 +479,8 @@ class BatchEngine:
             "action": table[packed, 0],
             "side": table[packed, 1],
             "is_market": table[packed, 2],
-            "price": table[packed, 3],
+            "price": table[packed, 3],  # absolute (events carry these)
+            "price_base": bases[packed],
             "oid_id": table[packed, 5],
             "uid_id": table[packed, 6],
         }
@@ -408,7 +530,11 @@ class BatchEngine:
             else:
                 out = jax.tree.map(lambda a: a[lane, t], outs)
             events = decode_events(
-                OpContext(order), out, self.oids, self.uids
+                OpContext(order),
+                out,
+                self.oids,
+                self.uids,
+                price_base=int(self.price_base[lane]),
             )
             if order.action is Action.DEL and not events:
                 self.stats.cancels_missed += 1
@@ -525,6 +651,12 @@ class BatchEngine:
             "dtype": np.dtype(self.config.dtype).name,
             "n_slots": self.n_slots,
             "max_t": self.max_t,
+            # JSON-safe lists: the durability layer folds everything but
+            # "books" into its (JSON) manifest.
+            "price_base": self.price_base.tolist(),
+            "base_set": self._base_set.astype(int).tolist(),
+            "env_lo": self._env_lo.tolist(),
+            "env_hi": self._env_hi.tolist(),
         }
 
     def import_state(self, state: dict) -> None:
@@ -550,10 +682,45 @@ class BatchEngine:
         self.symbols = Interner.from_list(list(state["symbols"]))
         self.oids = Interner.from_list(list(state["oids"]))
         self.uids = Interner.from_list(list(state["uids"]))
+        self._rebase = jnp.dtype(self.config.dtype).itemsize <= 4
+        n = self.n_slots
+        if "price_base" in state:
+            self.price_base = np.asarray(state["price_base"], np.int64).copy()
+            self._base_set = np.asarray(state["base_set"], bool).copy()
+            self._env_lo = np.asarray(state["env_lo"], np.int64).copy()
+            self._env_hi = np.asarray(state["env_hi"], np.int64).copy()
+        else:
+            # Pre-rebasing snapshot: stored prices are absolute, i.e. base 0.
+            # Lanes holding resting orders MUST be marked base-set at 0 —
+            # otherwise the next batch seeds a fresh base and encodes takers
+            # relative to it while the restored book stays absolute (silent
+            # non-matching). Envelope from the restored books themselves.
+            self.price_base = np.zeros(n, np.int64)
+            counts = np.asarray(b["count"])  # [S, 2]
+            occupied = counts.sum(axis=1) > 0
+            self._base_set = occupied.copy()
+            prices = np.asarray(b["price"]).astype(np.int64)  # [S, 2, cap]
+            cap = prices.shape[-1]
+            slot = np.arange(cap)
+            active = slot[None, None, :] < counts[:, :, None]
+            self._env_lo = np.where(
+                occupied, np.where(active, prices, np.iinfo(np.int64).max).min((1, 2)), 0
+            )
+            self._env_hi = np.where(
+                occupied, np.where(active, prices, 0).max((1, 2)), 0
+            )
 
     # -- views -------------------------------------------------------------
     def lane_books(self) -> BookState:
-        return jax.device_get(self.books)
+        """Host copy of the books with ABSOLUTE prices (per-lane rebasing
+        offsets added back; the price leaf widens to int64 when bases are in
+        play). Consumers of raw device state use export_state instead."""
+        books = jax.device_get(self.books)
+        if self._rebase and self._base_set.any():
+            price = np.asarray(books.price).astype(np.int64)
+            price = price + self.price_base[:, None, None]
+            books = books._replace(price=price)
+        return books
 
     def symbol_lane(self, symbol: str) -> int:
         """Read-only lookup: the lane owning `symbol`. Raises KeyError for a

@@ -204,6 +204,12 @@ def decode_grid_columnar(ops_meta: dict, outs_at) -> dict[str, np.ndarray]:
         maker_remaining = rec("maker_remaining")[src, j]
         maker_prefill = rec("maker_prefill")[src, j]
         maker_volume = np.where(maker_remaining == 0, maker_prefill, maker_remaining)
+        # Device prices are rebased per lane (32-bit books); events carry
+        # absolute ticks.
+        base = ops_meta.get("price_base")
+        fill_price = rec("fill_price")[src, j].astype(np.int64)
+        if base is not None:
+            fill_price = fill_price + base[src]
         fills = {
             "arrival": arrival[src],
             "is_cancel": np.zeros(len(src), np.bool_),
@@ -215,7 +221,7 @@ def decode_grid_columnar(ops_meta: dict, outs_at) -> dict[str, np.ndarray]:
             "taker_volume": rec("taker_after")[src, j],
             "maker_uid": rec("maker_uid")[src, j],
             "maker_oid": rec("maker_oid")[src, j],
-            "fill_price": rec("fill_price")[src, j],
+            "fill_price": fill_price,
             "maker_volume": maker_volume,
             "match_volume": fill_qty,
             "is_market": ops_meta["is_market"][src].astype(np.bool_),

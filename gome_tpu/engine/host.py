@@ -17,6 +17,7 @@ import numpy as np
 
 from ..types import Action, MatchResult, Order, OrderType, snapshot_of
 from .book import DeviceOp, StepOutput
+from .step import LOT_MAX32
 
 
 class Interner:
@@ -73,21 +74,36 @@ class OpContext:
 
 
 def encode_op(
-    order: Order, oids: Interner, uids: Interner, dtype=np.int64
+    order: Order,
+    oids: Interner,
+    uids: Interner,
+    dtype=np.int64,
+    price_base: int = 0,
 ) -> DeviceOp:
     """Order -> scalar DeviceOp (numpy scalars; cheap to batch later).
-    dtype must match BookConfig.dtype so the device writeback needs no cast."""
+    dtype must match BookConfig.dtype so the device writeback needs no cast.
+    price_base: the lane's rebasing offset (32-bit books store prices
+    relative to it; see BatchEngine._prepare_bases)."""
     if order.action is Action.ADD and order.volume <= 0:
         raise ValueError(
             f"volume must be positive, got {order.volume} (oid={order.oid}); "
             "volume<=0 is out of contract (see gome_tpu.oracle docstring)"
         )
+    if np.dtype(dtype).itemsize <= 4 and order.volume > LOT_MAX32:
+        raise ValueError(
+            f"volume {order.volume} exceeds the int32-mode per-order lot "
+            f"ceiling {LOT_MAX32} (oid={order.oid}); use coarser lot "
+            "units or an int64 BookConfig"
+        )
     val = np.dtype(dtype).type
+    is_market = order.order_type is OrderType.MARKET
+    # MARKET price is documented-ignored: encode 0 so an arbitrary client
+    # price can never overflow the lane's rebased int32 window.
     return DeviceOp(
         action=np.int32(int(order.action)),  # Action values == device codes
         side=np.int32(int(order.side)),
-        is_market=np.int32(order.order_type is OrderType.MARKET),
-        price=val(order.price),
+        is_market=np.int32(is_market),
+        price=val(0 if is_market else order.price - price_base),
         volume=val(order.volume),
         oid=val(oids.intern(order.oid)),
         uid=val(uids.intern(order.uuid)),
@@ -99,6 +115,7 @@ def decode_events(
     out: StepOutput,
     oids: Interner,
     uids: Interner,
+    price_base: int = 0,
 ) -> list[MatchResult]:
     """StepOutput -> the MatchResult events this op produced, in the
     reference's emission order (best level first, FIFO within level —
@@ -132,7 +149,7 @@ def decode_events(
                     oid=oids.lookup(int(out.maker_oid[j])),
                     symbol=order.symbol,
                     side=order.side.opposite,
-                    price=int(out.fill_price[j]),
+                    price=int(out.fill_price[j]) + price_base,
                     volume=maker_volume,
                 )
             )

@@ -63,16 +63,34 @@ def _bsel(c, a, b):
     return m * a + (1 - m) * b
 
 
+# Saturation ceiling for 32-bit depth prefix sums: with every addend
+# clamped here, one Hillis-Steele add of two partials stays below 2^31.
+# Exactness argument (int32 operating contract, per-order lots <= LOT_MAX):
+# a fill only reads cum_excl through clip(volume - cum_excl, 0, lots), so
+# any clamped value >= volume yields the same (zero) fill as the true sum,
+# and partials below the clamp are exact.
+SAT32_MAX = (1 << 30) - 1
+LOT_MAX32 = SAT32_MAX  # documented int32-mode per-order lot ceiling
+
+
 def _prefix_sum(a):
     """Inclusive prefix sum along the last axis via Hillis-Steele log-shift
     passes (static slice + pad + add). Used instead of jnp.cumsum because
     Mosaic (Pallas TPU) has no cumsum lowering; XLA fuses the passes into the
-    surrounding elementwise work either way."""
+    surrounding elementwise work either way.
+
+    32-bit inputs saturate at SAT32_MAX instead of wrapping — fills stay
+    exact (see SAT32_MAX) no matter how deep the crossed book is."""
     n = a.shape[-1]
+    sat = jnp.dtype(a.dtype).itemsize <= 4
+    if sat:
+        a = jnp.minimum(a, SAT32_MAX)
     k = 1
     while k < n:
         pad = [(0, 0)] * (a.ndim - 1) + [(k, 0)]
         a = a + jnp.pad(a[..., :-k], pad)
+        if sat:
+            a = jnp.minimum(a, SAT32_MAX)
         k *= 2
     return a
 
@@ -80,6 +98,13 @@ def _prefix_sum(a):
 def _shl1(a):
     """Static shift-by-one toward index 0, zero-filling the tail."""
     return jnp.pad(a[1:], (0, 1))
+
+
+def _shr1_last(a):
+    """Shift-by-one away from index 0 along the LAST axis (any rank),
+    zero-filling the head."""
+    pad = [(0, 0)] * (a.ndim - 1) + [(1, 0)]
+    return jnp.pad(a[..., :-1], pad)
 
 
 def _shr1(a):
@@ -151,7 +176,11 @@ def _match(
     crossing = active & (crosses != 0)
 
     clots = jnp.where(crossing, opp.lots, 0)
-    cum_excl = _prefix_sum(clots) - clots
+    # Exclusive prefix = inclusive prefix of the shifted array — computed
+    # directly (not incl - clots) so the 32-bit saturating scan stays
+    # consistent: subtracting an unclamped addend from a clamped total
+    # would under-report the depth ahead of a slot.
+    cum_excl = _prefix_sum(_shr1_last(clots))
     fill = jnp.clip(volume - cum_excl, 0, clots)
     total = jnp.sum(fill)
     remaining = volume - total

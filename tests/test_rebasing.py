@@ -1,0 +1,181 @@
+"""Per-lane price rebasing (32-bit books): absolute tick magnitudes beyond
+int32 (e.g. BTC at accuracy 8 ~ 1e13 ticks) match exactly, recentering
+shifts resting books without disturbing state, and bases survive
+snapshot/restore."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gome_tpu.engine import BatchEngine, BookConfig
+from gome_tpu.engine.batch import CapacityError
+from gome_tpu.oracle import OracleEngine
+from gome_tpu.types import Action, Order, Side
+
+BTC = 10_000_000_000_000  # 1e13 ticks = $100k at accuracy 8
+
+
+def _cfg32(**kw):
+    return BookConfig(cap=32, max_fills=8, dtype=jnp.int32, **kw)
+
+
+def test_btc_scale_prices_match_oracle():
+    rng = np.random.default_rng(5)
+    orders = []
+    for i in range(120):
+        is_del = i > 20 and rng.random() < 0.15
+        ref = rng.integers(1, i) if is_del else i
+        orders.append(
+            Order(
+                uuid="u", oid=str(ref if is_del else i), symbol="btc2usdt",
+                side=Side(int(rng.integers(0, 2))),
+                price=BTC + int(rng.integers(-500_000, 500_000)),
+                volume=int(rng.integers(1, 50)),
+                action=Action.DEL if is_del else Action.ADD,
+            )
+        )
+    oracle = OracleEngine()
+    expected = []
+    for o in orders:
+        expected.extend(oracle.process(o))
+    for use_columnar in (False, True):
+        eng = BatchEngine(_cfg32(), n_slots=2, max_t=64)
+        got = []
+        for i in range(0, len(orders), 48):
+            chunk = orders[i : i + 48]
+            if use_columnar:
+                got.extend(eng.process_columnar(chunk).to_results())
+            else:
+                got.extend(eng.process(chunk))
+        assert got == expected, f"columnar={use_columnar}"
+        assert all(e.match_node.price > (1 << 31) for e in got if not e.is_cancel)
+
+
+def test_recentering_preserves_resting_book():
+    """Rest an order, drift the flow by > REBASE_LIMIT ticks (forces a
+    recenter + device price shift), then cancel the original order at its
+    absolute price: the cancel must still find it."""
+    eng = BatchEngine(_cfg32(), n_slots=2, max_t=32)
+    drift = BatchEngine.REBASE_LIMIT + 50_000
+    rest = Order(uuid="u", oid="r", symbol="s", side=Side.BUY,
+                 price=BTC, volume=7)
+    far = Order(uuid="u", oid="f", symbol="s", side=Side.SALE,
+                price=BTC + drift, volume=3)
+    assert eng.process([rest]) == []
+    base0 = int(eng.price_base[0])
+    assert eng.process([far]) == []  # far ask rests; triggers recenter
+    assert int(eng.price_base[0]) != base0
+    # the resting bid survived the shift at its absolute price
+    cancel = Order(uuid="u", oid="r", symbol="s", side=Side.BUY,
+                   price=BTC, volume=0, action=Action.DEL)
+    events = eng.process([cancel])
+    assert len(events) == 1 and events[0].is_cancel
+    assert events[0].node.volume == 7
+    assert events[0].node.price == BTC
+
+
+def test_window_exhaustion_raises():
+    eng = BatchEngine(_cfg32(), n_slots=2, max_t=32)
+    eng.process([Order(uuid="u", oid="a", symbol="s", side=Side.BUY,
+                       price=BTC, volume=1)])
+    with pytest.raises(CapacityError, match="2\\^31 ticks"):
+        eng.process([Order(uuid="u", oid="b", symbol="s", side=Side.BUY,
+                           price=BTC + (1 << 33), volume=1)])
+
+
+def test_wide_first_batch_rejected_not_corrupted():
+    """A first micro-batch whose price span exceeds the int32 window must
+    raise CapacityError (regression: it used to seed an unchecked base and
+    silently wrap prices on the columnar path)."""
+    for use_columnar in (False, True):
+        eng = BatchEngine(_cfg32(), n_slots=2, max_t=32)
+        orders = [
+            Order(uuid="u", oid="a", symbol="s", side=Side.SALE,
+                  price=1, volume=1),
+            Order(uuid="u", oid="b", symbol="s", side=Side.BUY,
+                  price=BTC, volume=1),
+        ]
+        fn = eng.process_columnar if use_columnar else eng.process
+        with pytest.raises(CapacityError, match="2\\^31 ticks"):
+            fn(orders)
+
+
+def test_market_price_ignored_by_envelope():
+    """A MARKET order with Price:0 (in contract: price is ignored for
+    MARKET) must neither widen the lane's price envelope nor overflow the
+    rebased encoding (regression: it permanently poisoned the lane)."""
+    from gome_tpu.types import OrderType
+
+    oracle = OracleEngine()
+    orders = [
+        Order(uuid="u", oid="a", symbol="s", side=Side.SALE,
+              price=BTC, volume=5),
+        Order(uuid="u", oid="m", symbol="s", side=Side.BUY,
+              price=0, volume=3, order_type=OrderType.MARKET),
+        Order(uuid="u", oid="b", symbol="s", side=Side.SALE,
+              price=BTC + 10, volume=2),
+    ]
+    expected = []
+    for o in orders:
+        expected.extend(oracle.process(o))
+    for use_columnar in (False, True):
+        eng = BatchEngine(_cfg32(), n_slots=2, max_t=32)
+        fn = (
+            (lambda os_: eng.process_columnar(os_).to_results())
+            if use_columnar
+            else eng.process
+        )
+        got = fn(orders)
+        assert got == expected, f"columnar={use_columnar}"
+        assert len(got) == 1 and got[0].match_node.price == BTC
+
+
+def test_pre_rebasing_snapshot_restores_base_zero():
+    """Restoring a snapshot without rebasing metadata (older format) must
+    mark occupied lanes base-set at 0 so absolute stored prices keep
+    matching (regression: flow after restore silently stopped matching)."""
+    eng = BatchEngine(_cfg32(), n_slots=2, max_t=32)
+    # int32-representable absolute prices, as a pre-rebasing snapshot had
+    rest = Order(uuid="u", oid="a", symbol="s", side=Side.SALE,
+                 price=1_000_000, volume=5)
+    eng.process([rest])
+    state = eng.export_state()
+    for k in ("price_base", "base_set", "env_lo", "env_hi"):
+        del state[k]
+    # the old format stored absolute prices (base 0 everywhere)
+    state["books"]["price"] = (
+        np.asarray(state["books"]["price"]).astype(np.int64)
+        + eng.price_base[:, None, None]
+    ).astype(np.int32)
+    fresh = BatchEngine(_cfg32(), n_slots=2, max_t=32)
+    fresh.import_state(state)
+    taker = Order(uuid="u", oid="t", symbol="s", side=Side.BUY,
+                  price=1_000_100, volume=5)
+    events = fresh.process([taker])
+    assert len(events) == 1 and events[0].match_node.price == 1_000_000
+
+
+def test_bases_survive_snapshot_roundtrip():
+    eng = BatchEngine(_cfg32(), n_slots=2, max_t=32)
+    eng.process([Order(uuid="u", oid="a", symbol="s", side=Side.SALE,
+                       price=BTC + 10, volume=5)])
+    state = eng.export_state()
+    fresh = BatchEngine(_cfg32(), n_slots=2, max_t=32)
+    fresh.import_state(state)
+    np.testing.assert_array_equal(fresh.price_base, eng.price_base)
+    taker = Order(uuid="u", oid="t", symbol="s", side=Side.BUY,
+                  price=BTC + 100, volume=5)
+    ev1 = eng.process([taker])
+    ev2 = fresh.process([taker])
+    assert ev1 == ev2
+    assert len(ev1) == 1 and ev1[0].match_node.price == BTC + 10
+
+
+def test_lane_books_view_absolute():
+    eng = BatchEngine(_cfg32(), n_slots=2, max_t=32)
+    eng.process([Order(uuid="u", oid="a", symbol="s", side=Side.SALE,
+                       price=BTC + 42, volume=5)])
+    books = eng.lane_books()
+    lane = eng.symbol_lane("s")
+    assert int(books.price[lane, 1, 0]) == BTC + 42
