@@ -10,6 +10,7 @@ uuid="2", oid = loop index. Reports throughput the reference never measured
 
 from __future__ import annotations
 
+import collections
 import random
 import time
 
@@ -26,16 +27,17 @@ def load_client(
     uuid: str = "2",
     seed: int | None = None,
     kind: int = 0,
+    concurrency: int = 1,
 ) -> dict:
-    """Send n-1 orders synchronously (the reference's serial loop); returns
-    {sent, ok, rejected, elapsed_s, orders_per_s}."""
+    """Send n-1 orders (the reference's serial loop at concurrency=1; higher
+    values pipeline that many in-flight requests over one HTTP/2 channel —
+    the serial client measures round-trip latency, not server capacity).
+    Returns {sent, ok, rejected, elapsed_s, orders_per_s}."""
     rng = random.Random(seed)
-    sent = ok = rejected = 0
-    with grpc.insecure_channel(target) as channel:
-        stub = OrderStub(channel)
-        t0 = time.perf_counter()
+
+    def requests():  # lazy: O(window) client memory at any n
         for i in range(1, n):  # doorder.go:37 loop bounds
-            req = pb.OrderRequest(
+            yield pb.OrderRequest(
                 uuid=uuid,
                 oid=str(i),
                 symbol=symbol,
@@ -44,12 +46,29 @@ def load_client(
                 volume=round(rng.uniform(0.01, 1.0), 2),
                 kind=kind,
             )
-            resp = stub.DoOrder(req)
+
+    sent = ok = rejected = 0
+    window = max(1, concurrency)
+    with grpc.insecure_channel(target) as channel:
+        stub = OrderStub(channel)
+        t0 = time.perf_counter()
+        # One loop for both modes: a window of 1 sends request-after-response,
+        # exactly the reference's serial client.
+        pending = collections.deque()
+
+        def settle(f):
+            nonlocal ok, rejected
+            resp = f.result()
+            ok += resp.code == 0
+            rejected += resp.code != 0
+
+        for req in requests():
+            if len(pending) >= window:
+                settle(pending.popleft())
+            pending.append(stub.DoOrder.future(req))
             sent += 1
-            if resp.code == 0:
-                ok += 1
-            else:
-                rejected += 1
+        for f in pending:
+            settle(f)
         elapsed = time.perf_counter() - t0
     return {
         "sent": sent,
@@ -66,7 +85,8 @@ def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
     target = argv[0] if argv else "127.0.0.1:8088"
     n = int(argv[1]) if len(argv) > 1 else 2000
-    stats = load_client(target, n=n)
+    concurrency = int(argv[2]) if len(argv) > 2 else 1
+    stats = load_client(target, n=n, concurrency=concurrency)
     print(
         f"sent={stats['sent']} ok={stats['ok']} rejected={stats['rejected']} "
         f"elapsed={stats['elapsed_s']:.2f}s rate={stats['orders_per_s']:.0f}/s"
