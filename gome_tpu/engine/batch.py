@@ -38,6 +38,7 @@ import numpy as np
 
 from ..types import KERNELS, Action, MatchResult, Order
 from .book import (
+    BUY,
     BookConfig,
     BookState,
     DeviceOp,
@@ -122,6 +123,12 @@ class CapacityError(RuntimeError):
     re-shard rather than exhaust device memory."""
 
 
+class BookInvariantError(RuntimeError):
+    """verify_books found device book state violating a structural
+    invariant — an engine bug or external state corruption, never a
+    recoverable input condition."""
+
+
 @dataclasses.dataclass
 class EngineStats:
     """Host-side engine counters (new instrumentation — the reference has
@@ -161,6 +168,7 @@ class BatchEngine:
         max_cap: int = 1 << 14,
         kernel: str = "scan",
         pallas_interpret: bool = False,
+        mesh=None,
     ):
         """max_slots / max_cap bound auto-grow (symbol lanes / per-side book
         capacity). Growth past a ceiling raises CapacityError instead of
@@ -173,7 +181,15 @@ class BatchEngine:
         books, unblockable lane counts) — identical semantics either way, so
         the choice is purely a performance one. pallas_interpret=True forces
         the (slow) Pallas interpreter instead of that fallback; it exists so
-        CPU tests can exercise the kernel's code path."""
+        CPU tests can exercise the kernel's code path.
+
+        mesh: an optional 1-D jax.sharding.Mesh (gome_tpu.parallel.make_mesh)
+        partitioning the symbol-lane axis across chips. Matching needs zero
+        collectives (symbols share nothing, SURVEY §2.1), so the sharded
+        step is the same scan graph with shardings pinned; lane counts stay
+        multiples of the mesh size (growth rounds up). The compiled Pallas
+        kernel is single-chip — with a mesh the scan path runs (per-chip
+        Pallas under shard_map is future work)."""
         if kernel not in KERNELS:
             raise ValueError(f"kernel must be one of {KERNELS}, got {kernel!r}")
         if config.cap > max_cap:
@@ -188,7 +204,19 @@ class BatchEngine:
         self.max_cap = max_cap
         self.kernel = kernel
         self._pallas_interpret = pallas_interpret
-        self.books = init_books(config, n_slots)
+        self.mesh = mesh
+        if mesh is not None:
+            # Every place n_slots can be set (init, growth, restore) must
+            # produce a mesh multiple; enforcing the two static bounds here
+            # and rounding growth up lets _place assume divisibility.
+            for name, v in (("n_slots", n_slots), ("max_slots", max_slots)):
+                if v % mesh.size != 0:
+                    raise ValueError(
+                        f"{name} {v} must be a multiple of the mesh size "
+                        f"{mesh.size}"
+                    )
+        self._sharded_steppers: dict = {}  # BookConfig -> jitted step
+        self.books = self._place(init_books(config, n_slots))
         self.symbols = Interner()  # symbol -> lane id + 1 offset handled below
         self.oids = Interner()
         self.uids = Interner()
@@ -210,6 +238,14 @@ class BatchEngine:
     # Admission window around the current base; recenter when exceeded.
     REBASE_LIMIT = 1 << 30
     _INT32_SAFE = (1 << 31) - 2
+
+    def _place(self, books: BookState) -> BookState:
+        """Pin the lane axis across the mesh (no-op without one)."""
+        if self.mesh is None:
+            return books
+        from ..parallel.mesh import shard_batch
+
+        return shard_batch(self.mesh, books)
 
     def _grow_base_arrays(self, new_slots: int) -> None:
         pad = new_slots - len(self.price_base)
@@ -294,13 +330,16 @@ class BatchEngine:
                     f"n_slots={self.n_slots} (auto_grow disabled)"
                 )
             new_slots = min(max(self.n_slots * 2, lane + 1), self.max_slots)
+            if self.mesh is not None:
+                m = self.mesh.size
+                new_slots = min(((new_slots + m - 1) // m) * m, self.max_slots)
             if lane >= new_slots:
                 raise CapacityError(
                     f"symbol {symbol!r} needs lane {lane} but max_slots="
                     f"{self.max_slots}; raise max_slots or shard symbols "
                     "across more engines"
                 )
-            self.books = grow_lanes(self.books, new_slots)
+            self.books = self._place(grow_lanes(self.books, new_slots))
             self._grow_base_arrays(new_slots)
             self.n_slots = new_slots
             self.stats.lane_growths += 1
@@ -576,7 +615,7 @@ class BatchEngine:
                     f"{self.max_cap} (a side is holding >{self.config.cap} "
                     "resting orders); raise max_cap or shed load"
                 )
-            books_before = grow_books(books_before, new_cap)
+            books_before = self._place(grow_books(books_before, new_cap))
             self.config = dataclasses.replace(self.config, cap=new_cap)
         self.books = new_books
         outs = jax.device_get(outs)
@@ -611,6 +650,14 @@ class BatchEngine:
         requires S % block_s == 0 (n_slots growth keeps powers of two) and
         interprets off-TPU; escalation re-runs (lane_scan) stay on the scan
         path — they are rare and per-lane."""
+        if self.mesh is not None:
+            from ..parallel.mesh import shard_batch, sharded_batch_step
+
+            stepper = self._sharded_steppers.get(self.config)
+            if stepper is None:
+                stepper = sharded_batch_step(self.config, self.mesh)
+                self._sharded_steppers[self.config] = stepper
+            return stepper(books, shard_batch(self.mesh, ops))
         if self.kernel == "pallas":
             from ..ops import pallas_available, pallas_batch_step
 
@@ -676,9 +723,15 @@ class BatchEngine:
 
         ensure_dtype_usable(self.config.dtype)
         self.n_slots = int(state["n_slots"])
+        if self.mesh is not None and self.n_slots % self.mesh.size != 0:
+            raise ValueError(
+                f"snapshot n_slots {self.n_slots} is not a multiple of the "
+                f"mesh size {self.mesh.size}; restore into a non-mesh "
+                "engine or re-snapshot from a mesh-aligned one"
+            )
         self.max_t = int(state["max_t"])
         b = state["books"]
-        self.books = jax.device_put(BookState(**b))
+        self.books = self._place(jax.device_put(BookState(**b)))
         self.symbols = Interner.from_list(list(state["symbols"]))
         self.oids = Interner.from_list(list(state["oids"]))
         self.uids = Interner.from_list(list(state["uids"]))
@@ -709,6 +762,44 @@ class BatchEngine:
             self._env_hi = np.where(
                 occupied, np.where(active, prices, 0).max((1, 2)), 0
             )
+
+    def verify_books(self) -> None:
+        """Check every lane against the book invariants (priority-sorted
+        slots, positive resting lots, zeroed tails, FIFO seq within price
+        levels). O(S*cap) host work — a debug/ops API, not a hot-path check
+        (the reference's equivalent was panics sprinkled through the
+        linked-list code, nodelink.go:132-157). Raises BookInvariantError
+        with the offending lane/side on violation (explicit raises, not
+        asserts — python -O must not strip an ops check)."""
+
+        def check(cond, lane, side, what):
+            if not cond:
+                raise BookInvariantError(
+                    f"lane {lane} side {side}: {what}"
+                )
+
+        books = jax.device_get(self.books)
+        price = np.asarray(books.price)
+        lots = np.asarray(books.lots)
+        seq = np.asarray(books.seq)
+        counts = np.asarray(books.count)
+        cap = price.shape[-1]
+        for lane in range(counts.shape[0]):
+            for side in (0, 1):
+                n = int(counts[lane, side])
+                check(0 <= n <= cap, lane, side, f"count {n} out of range")
+                p, l, s = (a[lane, side] for a in (price, lots, seq))
+                check(bool((l[:n] > 0).all()), lane, side, "empty slot in prefix")
+                check(bool((l[n:] == 0).all()), lane, side, "lots beyond count")
+                if n > 1:
+                    dp = np.diff(p[:n].astype(np.int64))
+                    ordered = (dp <= 0) if side == BUY else (dp >= 0)
+                    check(bool(ordered.all()), lane, side, "priority order broken")
+                    same = dp == 0
+                    check(
+                        bool((np.diff(s[:n])[same] > 0).all()),
+                        lane, side, "FIFO seq order broken",
+                    )
 
     # -- views -------------------------------------------------------------
     def lane_books(self) -> BookState:

@@ -1,0 +1,87 @@
+"""Multi-process deployment over the file bus: a producer process (gateway
+role) publishes orders into the shared bus directory; the consumer process
+(this one) drains them through the device engine and publishes MatchResults
+— the reference's three-process topology with the file bus standing in for
+RabbitMQ (MIGRATION.md 'process topology')."""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from gome_tpu.bus import decode_match_result, make_bus
+from gome_tpu.config import BusConfig
+from gome_tpu.engine.orchestrator import MatchEngine
+from gome_tpu.engine.book import BookConfig
+from gome_tpu.oracle import OracleEngine
+from gome_tpu.service.consumer import OrderConsumer
+from gome_tpu.utils.streams import doorder_stream
+
+_PRODUCER = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from gome_tpu.bus import encode_order, make_bus
+from gome_tpu.config import BusConfig
+from gome_tpu.utils.streams import doorder_stream
+
+bus = make_bus(BusConfig(backend="file", dir={busdir!r}))
+orders = list(doorder_stream(n=120))
+bus.order_queue.publish_batch([encode_order(o) for o in orders])
+print(len(orders))
+"""
+
+
+def test_cross_process_file_bus_pipeline(tmp_path):
+    busdir = str(tmp_path / "bus")
+    out = subprocess.run(
+        [sys.executable, "-c", _PRODUCER.format(repo=_REPO, busdir=busdir)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    n_published = int(out.stdout.strip())
+
+    orders = list(doorder_stream(n=120))  # same stream the producer sent
+    oracle = OracleEngine()
+    expected = []
+    for o in orders:
+        expected.extend(oracle.process(o))
+
+    bus = make_bus(BusConfig(backend="file", dir=busdir))
+    engine = MatchEngine(BookConfig(cap=64, max_fills=8), n_slots=4)
+    for o in orders:
+        engine.mark(o)  # gateway-side marks (shared-process pre-pool model)
+    consumer = OrderConsumer(engine, bus, batch_n=64)
+    drained = consumer.drain()
+    assert drained == n_published == len(orders)
+
+    msgs = bus.match_queue.read_from(0, 10_000)
+    events = [decode_match_result(m.body) for m in msgs]
+    assert events == expected
+    engine.batch.verify_books()
+
+
+def test_verify_books_catches_corruption():
+    import jax
+    import numpy as np
+    import pytest
+
+    engine = MatchEngine(BookConfig(cap=16, max_fills=4), n_slots=2)
+    for o in doorder_stream(n=60):
+        engine.mark(o)
+        engine.process([o])
+    from gome_tpu.engine.batch import BookInvariantError
+
+    engine.batch.verify_books()  # healthy book passes
+    # corrupt: swap the top two bid slots' prices on the device copy
+    books = jax.device_get(engine.batch.books)
+    lane = engine.batch.symbol_lane("eth2usdt")
+    assert int(books.count[lane, 0]) >= 2, "stream must leave >=2 resting bids"
+    price = np.asarray(books.price).copy()
+    price[lane, 0, 0], price[lane, 0, 1] = (
+        price[lane, 0, 1] - 1,
+        price[lane, 0, 0] + 1,
+    )
+    engine.batch.books = jax.device_put(books._replace(price=price))
+    with pytest.raises(BookInvariantError):
+        engine.batch.verify_books()

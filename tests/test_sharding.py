@@ -118,3 +118,30 @@ def test_batch_engine_end_to_end_parity_on_8_devices():
     engine.books = shard_batch(mesh, engine.books)
     got = engine.process(orders)
     assert got == expected
+
+
+def test_batch_engine_mesh_param_matches_oracle():
+    """BatchEngine(mesh=...) — books pinned to the mesh through init, lane
+    growth (rounded to mesh multiples), and steps; same events as the
+    oracle."""
+    import jax
+
+    from gome_tpu.utils.streams import multi_symbol_stream
+
+    mesh = make_mesh(8)
+    engine = BatchEngine(CFG, n_slots=8, max_t=8, mesh=mesh)
+    orders = multi_symbol_stream(n=300, n_symbols=20, seed=9, cancel_prob=0.1)
+    oracle = OracleEngine()
+    expected = []
+    for order in orders:
+        expected.extend(oracle.process(order))
+    got = []
+    for i in range(0, len(orders), 64):
+        got.extend(engine.process(orders[i : i + 64]))
+    assert got == expected
+    assert engine.n_slots % mesh.size == 0 and engine.n_slots >= 20
+    shardings = {
+        str(getattr(l.sharding, "spec", None))
+        for l in jax.tree.leaves(engine.books)
+    }
+    assert "PartitionSpec('sym',)" in shardings
