@@ -28,8 +28,10 @@ from .codec import (
 )
 from .filelog import FileQueue
 from .memory import MemoryQueue
+from .ordercodec import decode_orders_batch
 
 __all__ = [
+    "decode_orders_batch",
     "Message",
     "Queue",
     "QueueBus",

@@ -731,7 +731,14 @@ class BatchEngine:
             )
         self.max_t = int(state["max_t"])
         b = state["books"]
-        self.books = self._place(jax.device_put(BookState(**b)))
+        books = BookState(**b)
+        # _place device_puts with the mesh sharding directly from host
+        # arrays; an inner device_put first would materialize the whole
+        # stack on one chip (the OOM the mesh exists to avoid).
+        self.books = (
+            self._place(books) if self.mesh is not None
+            else jax.device_put(books)
+        )
         self.symbols = Interner.from_list(list(state["symbols"]))
         self.oids = Interner.from_list(list(state["oids"]))
         self.uids = Interner.from_list(list(state["uids"]))

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 
-from ..bus import QueueBus, decode_order
+from ..bus import QueueBus, decode_orders_batch
 from ..engine.orchestrator import MatchEngine
 from ..utils.logging import get_logger
 from ..utils.metrics import REGISTRY
@@ -66,7 +66,8 @@ class OrderConsumer:
             return 0
         with _batch_latency.time() as timer:
             with annotate("decode_orders"):
-                orders = [decode_order(m.body) for m in msgs]
+                # one native call for the whole batch (json fallback inside)
+                orders = decode_orders_batch([m.body for m in msgs])
             with annotate("engine_process"):
                 # Columnar path end to end: events stay as numpy columns
                 # from decode through wire serialization; no per-event

@@ -1,0 +1,105 @@
+"""Native batch order codec: exact parity with the json path, graceful
+fallback on inputs the native parser declines."""
+
+import json
+import time
+
+import pytest
+
+from gome_tpu.bus import decode_orders_batch, encode_order
+from gome_tpu.bus.codec import decode_order
+from gome_tpu.bus.ordercodec import _load
+from gome_tpu.types import Action, Order, OrderType, Side
+from gome_tpu.utils.streams import mixed_stream
+
+
+def test_batch_decode_matches_json_path():
+    orders = mixed_stream(n=300, seed=8, cancel_prob=0.2, market_prob=0.15)
+    bodies = [encode_order(o) for o in orders]
+    assert decode_orders_batch(bodies) == [decode_order(b) for b in bodies]
+
+
+def test_batch_decode_fallback_cases():
+    """Escaped strings, unknown keys, missing optional keys, whitespace —
+    every message must decode exactly, native or fallback."""
+    bodies = [
+        encode_order(Order(uuid="u", oid="1", symbol="s", side=Side.BUY,
+                           price=5, volume=7)),
+        # escaped quote in oid -> native declines, json handles
+        json.dumps({"Uuid": "u", "Oid": 'o"x', "Symbol": "s",
+                    "Transaction": 1, "Price": 3, "Volume": 2}).encode(),
+        # unknown extra key -> native declines
+        b'{"Uuid":"a","Oid":"b","Symbol":"c","Transaction":0,"Price":1,'
+        b'"Volume":1,"Extra":9}',
+        # defaults: no Action, no Kind
+        b'{"Uuid":"x","Oid":"y","Symbol":"z","Transaction":1,"Price":10,'
+        b'"Volume":20}',
+        # whitespace + reordered keys + Kind
+        b'{ "Kind": 1 , "Volume": 4, "Price": 8, "Transaction": 0, '
+        b'"Symbol": "w", "Oid": "q", "Uuid": "e", "Action": 1 }',
+    ]
+    got = decode_orders_batch(bodies)
+    want = [decode_order(b) for b in bodies]
+    assert got == want
+    assert want[3].action is Action.ADD
+    assert want[3].order_type is OrderType.LIMIT
+    assert want[4].order_type is OrderType.MARKET
+
+
+def test_malformed_json_declines_to_fallback():
+    """Leading-zero ints, control chars in strings, int64 overflow: the
+    native parser must decline so behavior matches json.loads exactly."""
+    leading_zero = (
+        b'{"Uuid":"u","Oid":"o","Symbol":"s","Transaction":0,"Price":007,'
+        b'"Volume":1}'
+    )
+    ctrl = (
+        b'{"Uuid":"u\nx","Oid":"o","Symbol":"s","Transaction":0,"Price":1,'
+        b'"Volume":1}'
+    )
+    huge = (
+        b'{"Uuid":"u","Oid":"o","Symbol":"s","Transaction":0,'
+        b'"Price":99999999999999999999,"Volume":1}'
+    )
+    for body in (leading_zero, ctrl, huge):
+        try:
+            got = decode_orders_batch([body])
+        except Exception as e:
+            got = type(e).__name__
+        try:
+            want = [decode_order(body)]
+        except Exception as e:
+            want = type(e).__name__
+        assert got == want, body
+
+
+def test_out_of_range_enum_raises_like_json_path():
+    bad = (
+        b'{"Uuid":"u","Oid":"o","Symbol":"s","Transaction":7,"Price":1,'
+        b'"Volume":1}'
+    )
+    with pytest.raises(ValueError):
+        decode_orders_batch([bad])
+    with pytest.raises(ValueError):
+        decode_order(bad)
+
+
+def test_non_ascii_falls_back_exactly():
+    body = json.dumps({"Uuid": "u", "Oid": "o", "Symbol": "сим",
+                       "Transaction": 0, "Price": 1, "Volume": 1}).encode()
+    assert decode_orders_batch([body]) == [decode_order(body)]
+
+
+@pytest.mark.skipif(_load() is None, reason="no native toolchain")
+def test_native_path_is_faster():
+    orders = mixed_stream(n=4000, seed=1, cancel_prob=0.1)
+    bodies = [encode_order(o) for o in orders]
+    decode_orders_batch(bodies)  # warm lib
+    t0 = time.perf_counter()
+    decode_orders_batch(bodies)
+    native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    [decode_order(b) for b in bodies]
+    js = time.perf_counter() - t0
+    # loose bound: just prove the native call isn't a slower path in disguise
+    assert native < js * 1.5, (native, js)
