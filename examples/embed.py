@@ -1,0 +1,76 @@
+"""Embedding the matching engine as a library — no gRPC, no queues.
+
+Runs a mixed limit/market/cancel stream through the batched TPU engine and
+prints fills, book depth, and engine counters. This is the minimal
+"gome as a library" usage the reference never offered (its engine package is
+inseparable from Redis/RabbitMQ); here the book is a value you own.
+
+    python examples/embed.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+
+from gome_tpu.engine import BatchEngine, BookConfig
+from gome_tpu.engine.book import book_depth
+from gome_tpu.fixed import scale
+from gome_tpu.types import Action, Order, OrderType, Side
+
+
+def main():
+    engine = BatchEngine(
+        # int32 ticks + the Pallas kernel when on TPU; exact for any price
+        # magnitude via per-lane rebasing (ARCHITECTURE.md "Numeric model").
+        BookConfig(cap=64, max_fills=8, dtype=jnp.int32),
+        n_slots=2,
+        kernel="pallas",
+    )
+
+    mk = lambda oid, side, price, vol, **kw: Order(
+        uuid="alice", oid=oid, symbol="btc2usdt", side=side,
+        price=scale(price), volume=scale(vol), **kw
+    )
+    orders = [
+        mk("a1", Side.SALE, 100_000.0, 0.5),   # ask rests
+        mk("a2", Side.SALE, 100_010.0, 0.7),   # deeper ask
+        mk("b1", Side.BUY, 100_005.0, 0.6),    # crosses a1, partial a2? no:
+        #   fills 0.5 @ 100000, remainder 0.1 rests as bid @ 100005
+        mk("m1", Side.BUY, 0.0, 0.3, order_type=OrderType.MARKET),
+        #   market: sweeps best ask (a2) for 0.3
+        mk("a1x", Side.SALE, 99_990.0, 0.2),   # crosses the resting bid b1
+        Order(uuid="alice", oid="a2", symbol="btc2usdt", side=Side.SALE,
+              price=scale(100_010.0), volume=0, action=Action.DEL),
+    ]
+
+    batch = engine.process_columnar(orders)
+    for ev in batch.to_results():
+        kind = "CANCEL" if ev.is_cancel else "FILL  "
+        print(
+            f"{kind} taker={ev.node.oid:<4} maker={ev.match_node.oid:<4} "
+            f"qty={ev.match_volume} @ {ev.match_node.price}"
+        )
+
+    books = engine.lane_books()
+    lane = engine.symbol_lane("btc2usdt")
+    for side, name in ((0, "bids"), (1, "asks")):
+        import jax
+
+        one = jax.tree.map(lambda a: a[lane], books)
+        prices, vols, n = book_depth(one, side, max_levels=4)
+        levels = [
+            f"{int(prices[i])}x{int(vols[i])}" for i in range(int(n))
+        ]
+        print(f"{name}: {levels}")
+    print(f"stats: {engine.stats}")
+    engine.verify_books()
+    print("book invariants OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 BUY = 0
 SALE = 1
@@ -201,28 +202,33 @@ def book_depth(book: BookState, side: int, max_levels: int):
     """Aggregate [price, volume] depth view, best-first — the observable
     equivalent of the reference's S:BUY/S:SALE zset + S:depth hash
     (nodepool.go:61-83). Returns (prices[max_levels], volumes[max_levels],
-    n_levels); unused slots are zero.
+    n_levels) as int64 numpy arrays; unused slots are zero.
 
-    Segment-reduces the sorted per-order arrays: a new level starts wherever
-    the price differs from the previous active slot.
+    A host-side view: the caller typically passes a
+    BatchEngine.lane_books() book whose price leaf is already absolute
+    int64 — running this through jnp with x64 off would silently truncate
+    rebased-absolute prices back to 32 bits. Device-resident books are
+    pulled host-side in one transfer up front.
     """
-    cap = book.price.shape[-1]
-    idx = jnp.arange(cap)
-    active = idx < book.count[side]
-    price = book.price[side]
-    lots = jnp.where(active, book.lots[side], 0)
-    is_new = active & ((idx == 0) | (price != jnp.roll(price, 1)))
-    level_id = jnp.cumsum(is_new) - 1  # per-slot level index
-    level_id = jnp.where(active, level_id, max_levels)
-    volumes = jax.ops.segment_sum(lots, level_id, num_segments=max_levels + 1)[
-        :max_levels
-    ]
-    m = min(max_levels, cap)  # there can be at most `cap` distinct levels
-    first_slot = jnp.where(is_new, idx, cap)
-    order = jnp.argsort(first_slot)[:m]
-    prices = jnp.where(jnp.arange(m) < jnp.sum(is_new), price[order], 0)
-    prices = jnp.pad(prices, (0, max_levels - m))
-    # n is clipped to max_levels: callers iterate the returned arrays; a book
-    # with more distinct levels than max_levels is truncated (best-first).
-    n = jnp.minimum(jnp.sum(is_new), max_levels).astype(jnp.int32)
-    return prices, volumes, n
+    count, price, lots = jax.device_get(
+        (book.count[side], book.price[side], book.lots[side])
+    )
+    n_active = int(count)
+    price = np.asarray(price[:n_active], dtype=np.int64)
+    lots = np.asarray(lots[:n_active], dtype=np.int64)
+    prices = np.zeros(max_levels, np.int64)
+    volumes = np.zeros(max_levels, np.int64)
+    # slots are priority-sorted, so equal prices are contiguous runs
+    n = 0
+    i = 0
+    while i < n_active and n < max_levels:
+        j = i
+        while j < n_active and price[j] == price[i]:
+            j += 1
+        prices[n] = price[i]
+        volumes[n] = lots[i:j].sum()
+        n += 1
+        i = j
+    # n is clipped to max_levels: a book with more distinct levels than
+    # max_levels is truncated (best-first).
+    return prices, volumes, np.int32(n)
