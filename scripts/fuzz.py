@@ -1,0 +1,123 @@
+"""Differential fuzz: device engine vs the pure-Python oracle on randomized
+streams under adversarial engine geometries (tiny caps -> constant cap
+escalation, tiny max_fills -> record escalations, max_t=1 -> per-op grids,
+lane growth, int32 rebasing at extreme price bases, columnar + object
+decode paths).
+
+    python scripts/fuzz.py [n_cases] [seed0]
+
+Prints one line per case; exits nonzero on the first divergence with a
+reproducer description.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run_case(seed: int) -> str:
+    import jax.numpy as jnp
+
+    from gome_tpu.engine import BatchEngine, BookConfig
+    from gome_tpu.oracle import OracleEngine
+    from gome_tpu.types import Action, Order, OrderType, Side
+
+    rng = np.random.default_rng(seed)
+    cap = int(rng.choice([4, 8, 16, 64]))
+    max_fills = int(rng.choice([1, 2, 4, 8]))
+    max_t = int(rng.choice([1, 3, 16]))
+    n_slots = int(rng.choice([1, 2, 8]))
+    dtype = jnp.int32 if rng.random() < 0.5 else jnp.int64
+    use_columnar = bool(rng.random() < 0.5)
+    n_symbols = int(rng.choice([1, 3, 7]))
+    base_price = int(
+        rng.choice([100, 10_000_000, 10_000_000_000_000 if dtype == jnp.int32 else 100_000])
+    )
+    band = int(rng.choice([3, 50, 5_000]))
+    n_orders = int(rng.choice([50, 200]))
+    market_p = float(rng.choice([0.0, 0.15]))
+    cancel_p = float(rng.choice([0.0, 0.3]))
+    chunk = int(rng.choice([1, 17, 64]))
+
+    orders = []
+    # (symbol, oid, side, price) of prior limit ADDs: cancels need the exact
+    # resting side+price to hit (SURVEY §2.3.2); most cancels target those,
+    # a minority deliberately miss (wrong price) to cover the not-found path.
+    live: list[tuple[str, str, Side, int]] = []
+    for i in range(n_orders):
+        sym = f"s{int(rng.integers(n_symbols))}"
+        if live and rng.random() < cancel_p:
+            sym_o, oid, side_o, price_o = live[int(rng.integers(len(live)))]
+            if rng.random() < 0.25:  # deliberate miss
+                price_o = price_o + int(rng.integers(1, band + 2))
+            orders.append(
+                Order(uuid="u", oid=oid, symbol=sym_o, side=side_o,
+                      price=price_o, volume=0, action=Action.DEL)
+            )
+            continue
+        kind = OrderType.MARKET if rng.random() < market_p else OrderType.LIMIT
+        side = Side(int(rng.integers(2)))
+        price = (
+            0 if (kind is OrderType.MARKET and rng.random() < 0.5)
+            else base_price + int(rng.integers(-band, band + 1))
+        )
+        orders.append(
+            Order(uuid=f"u{int(rng.integers(3))}", oid=str(i), symbol=sym,
+                  side=side, price=price, volume=int(rng.integers(1, 30)),
+                  order_type=kind)
+        )
+        if kind is OrderType.LIMIT:
+            live.append((sym, str(i), side, price))
+
+    oracle = OracleEngine()
+    expected = []
+    for o in orders:
+        expected.extend(oracle.process(o))
+
+    engine = BatchEngine(
+        BookConfig(cap=cap, max_fills=max_fills, dtype=dtype),
+        n_slots=n_slots, max_t=max_t,
+    )
+    got = []
+    for i in range(0, len(orders), chunk):
+        part = orders[i : i + chunk]
+        if use_columnar:
+            got.extend(engine.process_columnar(part).to_results())
+        else:
+            got.extend(engine.process(part))
+    desc = (
+        f"seed={seed} cap={cap} K={max_fills} max_t={max_t} slots={n_slots} "
+        f"dtype={np.dtype(dtype).name} columnar={use_columnar} "
+        f"base={base_price} band={band} n={n_orders} chunk={chunk}"
+    )
+    if got != expected:
+        first = next(
+            (j for j, (a, b) in enumerate(zip(got, expected)) if a != b),
+            min(len(got), len(expected)),
+        )
+        raise AssertionError(
+            f"DIVERGENCE [{desc}] events {len(got)} vs {len(expected)}, "
+            f"first mismatch at {first}:\n got: "
+            f"{got[first] if first < len(got) else '<none>'}\n exp: "
+            f"{expected[first] if first < len(expected) else '<none>'}"
+        )
+    engine.verify_books()
+    return f"OK [{desc}] events={len(got)} esc={engine.stats.cap_escalations}/{engine.stats.fill_record_escalations}"
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 30
+    seed0 = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    for s in range(seed0, seed0 + n):
+        print(run_case(s), flush=True)
+    print(f"ALL {n} CASES PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+"""CI slice of the differential fuzzer (scripts/fuzz.py): randomized
+streams under adversarial engine geometries vs the oracle. Run the script
+directly for deeper sweeps."""
+
+import importlib.util
+import os
+
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "gome_fuzz",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "fuzz.py",
+    ),
+)
+_fuzz = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_fuzz)
+
+
+@pytest.mark.parametrize("seed", range(500, 512))
+def test_fuzz_case(seed):
+    print(_fuzz.run_case(seed))
