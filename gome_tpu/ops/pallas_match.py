@@ -25,9 +25,10 @@ allows unaligned dynamic offsets on the major dim):
     each step reads the [8, B] slab at its (major-dim, unaligned-ok) time
     index and peels rows.
   * the 7 per-op scalar outputs come back the same way: one [T, 8, S] pack.
-  * the 7 per-op fill-record arrays come back time-leading as [T, K, S];
-    the step's [B, K] records are transposed in-VMEM so the lane dim stays
-    the (dense) symbol block.
+  * the 5 non-derivable per-op fill-record arrays come back time-leading as
+    [T, K, S]; the step's [B, K] records are transposed in-VMEM so the lane
+    dim stays the (dense) symbol block (fill_qty / taker_after are
+    reconstructed outside the kernel — see _REC_FIELDS).
 The host repacks to the public [S, T, ...] StepOutput shapes outside the
 kernel — pure XLA transposes, off the hot dependency chain.
 
@@ -49,9 +50,13 @@ from jax.experimental import pallas as pl
 from ..engine.book import BookConfig, BookState, DeviceOp, StepOutput
 from ..engine.step import _Side, step_rows_impl
 
+# Only the 5 non-derivable record fields cross the kernel boundary:
+# fill_qty == maker_prefill - maker_remaining and taker_after ==
+# taker volume - cumsum(fill_qty) are reconstructed outside (less VMEM,
+# fewer in-kernel transposes, less HBM).
 _REC_FIELDS = (
-    "fill_price", "fill_qty", "maker_oid", "maker_uid",
-    "maker_prefill", "maker_remaining", "taker_after",
+    "fill_price", "maker_oid", "maker_uid",
+    "maker_prefill", "maker_remaining",
 )
 _SCALAR_FIELDS = (
     "n_fills", "fill_overflow", "taker_remaining", "rested",
@@ -68,14 +73,14 @@ def pallas_available(dtype=jnp.int32) -> bool:
 
 def _kernel(config: BookConfig, t_len: int, *refs):
     """refs: 12 book-in (5 buy rows, 5 sale rows, count, next_seq) +
-    1 op-pack-in + 12 book-out + 7 record-out + 1 scalar-pack-out.
+    1 op-pack-in + 12 book-out + 5 record-out + 1 scalar-pack-out.
     See module docstring for layouts."""
     (bb_p, bb_l, bb_s, bb_o, bb_u, sb_p, sb_l, sb_s, sb_o, sb_u,
      cnt, nsq, ops,
      ob_p, ob_l, ob_s, ob_o, ob_u, os_p, os_l, os_s, os_o, os_u,
      ocnt, onsq,
-     fp, fq, mo, mu, mp, mr, ta, scal) = refs
-    rec_refs = (fp, fq, mo, mu, mp, mr, ta)
+     fp, mo, mu, mp, mr, scal) = refs
+    rec_refs = (fp, mo, mu, mp, mr)
 
     buy = _Side(bb_p[...], bb_l[...], bb_s[...], bb_o[...], bb_u[...])
     sale = _Side(sb_p[...], sb_l[...], sb_s[...], sb_o[...], sb_u[...])
@@ -194,10 +199,10 @@ def pallas_batch_step(
         ]
     )
     in_specs = book_specs + [tspec(t_len, 8)]
-    out_specs = book_specs + [tspec(t_len, k)] * 7 + [tspec(t_len, 8)]
+    out_specs = book_specs + [tspec(t_len, k)] * 5 + [tspec(t_len, 8)]
     out_shape = (
         book_shape
-        + [jax.ShapeDtypeStruct((t_len, k, s), dt)] * 7
+        + [jax.ShapeDtypeStruct((t_len, k, s), dt)] * 5
         + [jax.ShapeDtypeStruct((t_len, 8, s), dt)]  # scalar pack
     )
     aliases = {i: i for i in range(12)}
@@ -224,7 +229,7 @@ def pallas_batch_step(
         interpret=interpret,
     )(*rows_in, books.count, books.next_seq[:, None], op_pack)
     (ob_p, ob_l, ob_s, ob_o, ob_u, os_p, os_l, os_s, os_o, os_u,
-     ocnt, onsq, fp, fq, mo, mu, mp, mr, ta, scal) = outs
+     ocnt, onsq, fp, mo, mu, mp, mr, scal) = outs
 
     pair = lambda b, a: jnp.stack([b, a], axis=1)  # [S, cap] x2 -> [S, 2, cap]
     new_books = BookState(
@@ -239,10 +244,19 @@ def pallas_batch_step(
     sca = jnp.transpose(scal, (2, 0, 1))  # [T, 8, S] -> [S, T, 8]
     fields = {
         f: jnp.transpose(r, (2, 0, 1))  # [T, K, S] -> [S, T, K]
-        for f, r in zip(_REC_FIELDS, (fp, fq, mo, mu, mp, mr, ta))
+        for f, r in zip(_REC_FIELDS, (fp, mo, mu, mp, mr))
     }
     for i, f in enumerate(_SCALAR_FIELDS):
         want = dt if f in ("taker_remaining", "cancel_volume") else jnp.int32
         fields[f] = sca[..., i].astype(want)
+    # Derived record fields (post-kernel XLA; see _REC_FIELDS note). Both
+    # are exactly the step's definitions: qty = maker lots consumed;
+    # taker_after = taker volume minus the inclusive fill prefix, reported
+    # only on slots that filled.
+    qty = fields["maker_prefill"] - fields["maker_remaining"]  # [S, T, K]
+    fields["fill_qty"] = qty
+    cum = jnp.cumsum(qty, axis=-1)
+    vol = ops.volume.astype(dt)[:, :, None]
+    fields["taker_after"] = jnp.where(qty > 0, vol - cum, 0)
     out = StepOutput(**fields)
     return new_books, out
