@@ -4,10 +4,12 @@ escalation, tiny max_fills -> record escalations, max_t=1 -> per-op grids,
 lane growth, int32 rebasing at extreme price bases, columnar + object
 decode paths).
 
-    python scripts/fuzz.py [n_cases] [seed0]
+    python scripts/fuzz.py [n_cases] [seed0] [--tpu]
 
 Prints one line per case; exits nonzero on the first divergence with a
-reproducer description.
+reproducer description. Runs on CPU by default — the fuzz target is
+SEMANTICS, and every randomized geometry is a fresh ~30s TPU compile over
+the tunnel; pass --tpu to fuzz the real-TPU lowering anyway.
 """
 
 from __future__ import annotations
@@ -16,6 +18,12 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--tpu" not in sys.argv:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
 
 import numpy as np
 
