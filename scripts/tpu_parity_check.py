@@ -2,7 +2,7 @@
 real TPU (the pytest suite runs the kernel in interpreter mode on CPU; this
 script closes the compiled-lowering gap). Run on a TPU host:
 
-    python scripts/tpu_parity_check.py
+    python scripts/tpu_parity_check.py [S T CAP K G]
 
 Exit 0 on exact equality of every book leaf and every StepOutput leaf
 across chained grids of crossing flow (with cancels and market orders).
@@ -31,7 +31,8 @@ def main():
         return 0
     assert pallas_available(jnp.int32)
 
-    S, T, CAP, K, G = 512, 16, 128, 16, 4
+    args = [int(a) for a in sys.argv[1:6]]
+    S, T, CAP, K, G = args + [512, 16, 128, 16, 4][len(args):]
     config = BookConfig(cap=CAP, max_fills=K, dtype=jnp.int32)
     rng = np.random.default_rng(7)
 
