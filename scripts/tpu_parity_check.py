@@ -33,6 +33,12 @@ def main():
 
     args = [int(a) for a in sys.argv[1:6]]
     S, T, CAP, K, G = args + [512, 16, 128, 16, 4][len(args):]
+    # Compiled-kernel lane-blocking policy (same as BatchEngine/bench.py).
+    block_s = 128 if S % 128 == 0 else (S if S <= 256 else None)
+    if block_s is None:
+        print(f"S={S} has no valid compiled-kernel blocking "
+              "(need S % 128 == 0 or S <= 256)")
+        return 2
     config = BookConfig(cap=CAP, max_fills=K, dtype=jnp.int32)
     rng = np.random.default_rng(7)
 
@@ -54,7 +60,7 @@ def main():
         ops = grid(g)
         b_scan, o_scan = batch_step(config, b_scan, ops)
         b_pall, o_pall = pallas_batch_step(
-            config, b_pall, ops, block_s=128, interpret=False
+            config, b_pall, ops, block_s=block_s, interpret=False
         )
         for name in o_scan._fields:
             a = np.asarray(jax.device_get(getattr(o_scan, name)))
