@@ -180,25 +180,23 @@ def main():
     )
 
     if KERNEL == "pallas":
-        from gome_tpu.ops import pallas_available, pallas_batch_step
+        from gome_tpu.ops import (
+            default_block_s,
+            pallas_available,
+            pallas_batch_step,
+        )
 
         interp = not pallas_available(config.dtype)
-        # Compiled-kernel blocking rule: 128-multiples or one whole-axis
-        # block (VMEM-bounded, so only for modest S — same policy as
-        # BatchEngine); interpret mode (CPU check) has no constraint.
-        if interp:
+        if interp:  # interpret mode (CPU check) has no blocking constraint
             default_block = next(b for b in (128, 8, 1) if S % b == 0)
-        elif S % 128 == 0:
-            default_block = 128
-        elif S <= 256:
-            default_block = S
         else:
-            print(
-                f"# NOTE: S={S} has no valid compiled-kernel blocking; "
-                "falling back to the scan kernel",
-                file=sys.stderr,
-            )
-            default_block = None
+            default_block = default_block_s(S)
+            if default_block is None:
+                print(
+                    f"# NOTE: S={S} has no valid compiled-kernel blocking; "
+                    "falling back to the scan kernel",
+                    file=sys.stderr,
+                )
         block_s = (
             int(os.environ["BENCH_BLOCK_S"])
             if "BENCH_BLOCK_S" in os.environ

@@ -659,13 +659,14 @@ class BatchEngine:
                 self._sharded_steppers[self.config] = stepper
             return stepper(books, shard_batch(self.mesh, ops))
         if self.kernel == "pallas":
-            from ..ops import pallas_available, pallas_batch_step
+            from ..ops import (
+                default_block_s,
+                pallas_available,
+                pallas_batch_step,
+            )
 
             s = ops.action.shape[0]
-            # Lane-dim blocking rule of the compiled kernel: 128-multiples,
-            # or one block spanning the whole axis (VMEM-bounded: a single
-            # whole-axis block only fits for modest lane counts).
-            block_s = 128 if s % 128 == 0 else (s if s <= 256 else None)
+            block_s = default_block_s(s)
             if self._pallas_interpret and block_s is None:
                 block_s = next(b for b in (8, 1) if s % b == 0)
             if block_s is not None and (

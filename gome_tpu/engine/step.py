@@ -239,7 +239,9 @@ def _remove(config: BookConfig, own: _Side, own_count, oid, price):
     idx = jnp.arange(cap, dtype=jnp.int32)
     active = idx < own_count
     hit = active & (own.oid == oid) & (own.price == price)
-    found = jnp.any(hit)
+    # Integer reduction, not jnp.any: Mosaic lowers boolean reductions
+    # through a float max, which is unsupported for some widths.
+    found = jnp.sum(hit.astype(jnp.int32)) > 0
     # oids unique by contract, so the hit mask has at most one set slot:
     # masked sums replace the dynamic argmax-index reads (gather-free).
     pos = jnp.sum(jnp.where(hit, idx, 0)).astype(jnp.int32)

@@ -1,5 +1,5 @@
 """Custom TPU kernels (Pallas) for the matching hot path."""
 
-from .pallas_match import pallas_batch_step, pallas_available
+from .pallas_match import default_block_s, pallas_available, pallas_batch_step
 
-__all__ = ["pallas_batch_step", "pallas_available"]
+__all__ = ["default_block_s", "pallas_available", "pallas_batch_step"]

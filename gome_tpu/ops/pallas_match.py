@@ -71,6 +71,19 @@ def pallas_available(dtype=jnp.int32) -> bool:
     return jax.default_backend() == "tpu" and jnp.dtype(dtype).itemsize <= 4
 
 
+def default_block_s(s: int) -> int | None:
+    """The compiled kernel's lane-blocking policy, in ONE place: 128-lane
+    blocks when the lane count divides, else one sublane-aligned whole-axis
+    block (VMEM-bounded, so only for modest s; s % 8 != 0 hits unsupported
+    Mosaic relayouts). None means no valid blocking — callers fall back to
+    the scan path."""
+    if s % 128 == 0:
+        return 128
+    if s <= 256 and s % 8 == 0:
+        return s
+    return None
+
+
 def _kernel(config: BookConfig, t_len: int, *refs):
     """refs: 12 book-in (5 buy rows, 5 sale rows, count, next_seq) +
     1 op-pack-in + 12 book-out + 5 record-out + 1 scalar-pack-out.
@@ -131,7 +144,10 @@ def _kernel(config: BookConfig, t_len: int, *refs):
         ref[...] = v
     for ref, v in zip((os_p, os_l, os_s, os_o, os_u), sale):
         ref[...] = v
-    ocnt[...] = jnp.concatenate([nb, ns], axis=-1)
+    # Two static slice-stores, not a concat: Mosaic's vector concat rejects
+    # tiny lane extents (offset mismatch at block_s == 1).
+    ocnt[:, 0:1] = nb
+    ocnt[:, 1:2] = ns
     onsq[...] = nq
 
 
@@ -154,13 +170,16 @@ def pallas_batch_step(
     s, t_len = ops.action.shape
     if s % block_s != 0:
         raise ValueError(f"S={s} not a multiple of block_s={block_s}")
-    if not interpret and not (block_s % 128 == 0 or block_s == s):
+    if not interpret and not (
+        block_s % 128 == 0 or (block_s == s and block_s % 8 == 0)
+    ):
         # Packed op/record/scalar blocks put the symbol axis on the lane
         # dim; Mosaic requires lane-dim blocks to be 128-multiples unless
-        # the block spans the full axis.
+        # the block spans the full axis — and sub-sublane blocks (B % 8
+        # != 0) hit unsupported pad/concat relayouts in the book rows.
         raise ValueError(
-            f"compiled kernel needs block_s % 128 == 0 or block_s == S "
-            f"(got block_s={block_s}, S={s})"
+            f"compiled kernel needs block_s % 128 == 0, or block_s == S "
+            f"with S % 8 == 0 (got block_s={block_s}, S={s})"
         )
     cap = config.cap
     k = config.max_fills
@@ -219,7 +238,7 @@ def pallas_batch_step(
         for f in ("price", "lots", "seq", "oid", "uid")
     ]
 
-    outs = pl.pallas_call(
+    call = pl.pallas_call(
         functools.partial(_kernel, config, t_len),
         grid=grid,
         in_specs=in_specs,
@@ -227,7 +246,17 @@ def pallas_batch_step(
         out_shape=out_shape,
         input_output_aliases=aliases,
         interpret=interpret,
-    )(*rows_in, books.count, books.next_seq[:, None], op_pack)
+    )
+    call_args = (*rows_in, books.count, books.next_seq[:, None], op_pack)
+    if interpret:
+        outs = call(*call_args)
+    else:
+        # Trace the compiled kernel with x64 promotion off regardless of the
+        # global flag: every input is concretely 32-bit, but with x64 on,
+        # Python-int literals inside the kernel promote to int64 and send
+        # Mosaic's convert_element_type lowering into infinite recursion.
+        with jax.enable_x64(False):
+            outs = call(*call_args)
     (ob_p, ob_l, ob_s, ob_o, ob_u, os_p, os_l, os_s, os_o, os_u,
      ocnt, onsq, fp, mo, mu, mp, mr, scal) = outs
 

@@ -19,11 +19,18 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if "--tpu" not in sys.argv:
-    import jax
+import jax
 
+if "--tpu" not in sys.argv:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_enable_x64", True)
+# Enable x64 up front in BOTH modes: int64 cases would flip it mid-process
+# (engine.book.ensure_dtype_usable), and flipping jax_enable_x64 between
+# traced cases can send jax's dtype-promotion cache into infinite recursion
+# on a later pallas retrace (observed on TPU). Caveat: int32 SCAN-path cases
+# therefore fuzz under x64-on promotion, whereas the production bench runs
+# x64 off — the compiled-kernel trace is x64-immune (pallas_match pins the
+# flag off), and bench.py itself covers the x64-off scan configuration.
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np
 
@@ -39,7 +46,7 @@ def run_case(seed: int) -> str:
     cap = int(rng.choice([4, 8, 16, 64]))
     max_fills = int(rng.choice([1, 2, 4, 8]))
     max_t = int(rng.choice([1, 3, 16]))
-    n_slots = int(rng.choice([1, 2, 8]))
+    n_slots = int(rng.choice([1, 2, 8, 16]))
     dtype = jnp.int32 if rng.random() < 0.5 else jnp.int64
     use_columnar = bool(rng.random() < 0.5)
     n_symbols = int(rng.choice([1, 3, 7]))
@@ -87,9 +94,18 @@ def run_case(seed: int) -> str:
     for o in orders:
         expected.extend(oracle.process(o))
 
+    # GOME_FUZZ_KERNEL=pallas (with --tpu) fuzzes the COMPILED kernel inside
+    # the full engine: escalation replays, rebasing, growth — each geometry
+    # is a fresh Mosaic compile, so keep case counts small on TPU. The
+    # engine falls back to scan when the compiled kernel cannot run (int64,
+    # unblockable lane counts); the effective path is printed per case so a
+    # green run cannot masquerade as compiled-kernel coverage.
+    kernel = os.environ.get("GOME_FUZZ_KERNEL", "scan")
+    if kernel not in ("scan", "pallas"):
+        raise ValueError(f"GOME_FUZZ_KERNEL must be scan|pallas, got {kernel!r}")
     engine = BatchEngine(
         BookConfig(cap=cap, max_fills=max_fills, dtype=dtype),
-        n_slots=n_slots, max_t=max_t,
+        n_slots=n_slots, max_t=max_t, kernel=kernel,
     )
     got = []
     for i in range(0, len(orders), chunk):
@@ -98,10 +114,20 @@ def run_case(seed: int) -> str:
             got.extend(engine.process_columnar(part).to_results())
         else:
             got.extend(engine.process(part))
+    from gome_tpu.ops import default_block_s, pallas_available
+
+    effective = (
+        "pallas"
+        if kernel == "pallas"
+        and pallas_available(dtype)
+        and default_block_s(engine.n_slots) is not None
+        else "scan"
+    )
     desc = (
         f"seed={seed} cap={cap} K={max_fills} max_t={max_t} slots={n_slots} "
         f"dtype={np.dtype(dtype).name} columnar={use_columnar} "
-        f"base={base_price} band={band} n={n_orders} chunk={chunk}"
+        f"kernel={effective} base={base_price} band={band} n={n_orders} "
+        f"chunk={chunk}"
     )
     if got != expected:
         first = next(

@@ -33,11 +33,12 @@ def main():
 
     args = [int(a) for a in sys.argv[1:6]]
     S, T, CAP, K, G = args + [512, 16, 128, 16, 4][len(args):]
-    # Compiled-kernel lane-blocking policy (same as BatchEngine/bench.py).
-    block_s = 128 if S % 128 == 0 else (S if S <= 256 else None)
+    from gome_tpu.ops import default_block_s
+
+    block_s = default_block_s(S)
     if block_s is None:
         print(f"S={S} has no valid compiled-kernel blocking "
-              "(need S % 128 == 0 or S <= 256)")
+              "(see gome_tpu.ops.default_block_s)")
         return 2
     config = BookConfig(cap=CAP, max_fills=K, dtype=jnp.int32)
     rng = np.random.default_rng(7)
