@@ -101,6 +101,69 @@ def batch_step(
 lane_scan = functools.partial(jax.jit, static_argnums=0)(_lane_scan_impl)
 
 
+@functools.partial(jax.jit, static_argnums=0)
+def dense_batch_step(
+    config: BookConfig, books: BookState, lane_ids, ops: DeviceOp
+):
+    """Gather→scan→scatter over a compact set of LIVE lanes.
+
+    Skewed real-world flow (BASELINE config 4: Zipf arrivals over 10K
+    symbols) leaves most of a full [S, T] grid as NOP padding — the device
+    would spend >99% of its work stepping idle books. This step instead
+    gathers the R live lanes' books into a dense [R, ...] sub-stack, scans
+    a compact [R, T] op grid (T can be much deeper than the full-grid
+    max_t, amortizing dispatch for hot symbols — the config 1-2 latency
+    path), and scatters the sub-stack back. Cost: one O(S) copy for the
+    scatter (XLA preserves the un-donated input) plus O(R·T) matching work,
+    vs O(S·T) matching work for the full grid.
+
+    lane_ids: [R] int32, padded to the compile-bucketed row count with an
+    out-of-range sentinel (>= S). Sentinel rows gather zero books
+    (mode="fill"), scan pure-NOP op rows (the packer guarantees this), and
+    are dropped by the scatter (mode="drop") — no aliasing, no branches.
+    """
+    sub = jax.tree.map(
+        lambda a: jnp.take(a, lane_ids, axis=0, mode="fill", fill_value=0),
+        books,
+    )
+    sub, outs = jax.vmap(lambda b, o: _lane_scan_impl(config, b, o))(sub, ops)
+    new_books = jax.tree.map(
+        lambda a, s: a.at[lane_ids].set(s, mode="drop"), books, sub
+    )
+    return new_books, outs
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4, 5))
+def dense_kernel_step(
+    config: BookConfig,
+    books: BookState,
+    lane_ids,
+    ops: DeviceOp,
+    block_s: int,
+    interpret: bool = False,
+):
+    """dense_batch_step with the VMEM-resident Pallas kernel as the inner
+    step (gome_tpu.ops.pallas_match) instead of scan x vmap. For few-lane
+    deep grids this is the difference between ~40us/op (every scan step
+    pays XLA kernel-launch overhead on a sequential dependency chain) and
+    the in-kernel fori_loop running entirely out of VMEM — the single-hot-
+    symbol latency path lives here. Row count must satisfy the kernel's
+    blocking rule (the packer pads rows to >= 8, a power of two)."""
+    from ..ops import pallas_batch_step
+
+    sub = jax.tree.map(
+        lambda a: jnp.take(a, lane_ids, axis=0, mode="fill", fill_value=0),
+        books,
+    )
+    sub, outs = pallas_batch_step(
+        config, sub, ops, block_s=block_s, interpret=interpret
+    )
+    new_books = jax.tree.map(
+        lambda a, s: a.at[lane_ids].set(s, mode="drop"), books, sub
+    )
+    return new_books, outs
+
+
 def _nop_grid(config: BookConfig, n_slots: int, t: int) -> dict[str, np.ndarray]:
     i32 = lambda: np.zeros((n_slots, t), np.int32)
     val = lambda: np.zeros((n_slots, t), np.dtype(config.dtype))
@@ -169,6 +232,8 @@ class BatchEngine:
         kernel: str = "scan",
         pallas_interpret: bool = False,
         mesh=None,
+        dense: bool = True,
+        dense_t_max: int = 1024,
     ):
         """max_slots / max_cap bound auto-grow (symbol lanes / per-side book
         capacity). Growth past a ceiling raises CapacityError instead of
@@ -182,6 +247,13 @@ class BatchEngine:
         the choice is purely a performance one. pallas_interpret=True forces
         the (slow) Pallas interpreter instead of that fallback; it exists so
         CPU tests can exercise the kernel's code path.
+
+        dense: allow the columnar path to pack batches touching few symbols
+        into compact gather/scatter grids over just the live lanes
+        (dense_batch_step) instead of the full [n_slots, max_t] grid —
+        throughput then tracks APPLIED ops, not provisioned lanes (Zipf
+        flows), and a hot symbol's stream can run dense_t_max deep per
+        device call (the single-symbol latency path). Semantics identical.
 
         mesh: an optional 1-D jax.sharding.Mesh (gome_tpu.parallel.make_mesh)
         partitioning the symbol-lane axis across chips. Matching needs zero
@@ -205,6 +277,8 @@ class BatchEngine:
         self.kernel = kernel
         self._pallas_interpret = pallas_interpret
         self.mesh = mesh
+        self.dense = dense
+        self.dense_t_max = dense_t_max
         if mesh is not None:
             # Every place n_slots can be set (init, growth, restore) must
             # produce a mesh multiple; enforcing the two static bounds here
@@ -254,23 +328,35 @@ class BatchEngine:
         self._env_lo = np.pad(self._env_lo, (0, pad))
         self._env_hi = np.pad(self._env_hi, (0, pad))
 
-    def _prepare_bases(self, pending, lanes) -> None:
-        """Set / recenter per-lane price bases so every price in `pending`
-        is representable on device. Runs before packing; recentering shifts
-        the lane's resting prices on device (rare — only when flow drifts
-        more than REBASE_LIMIT ticks from the current base)."""
+    def _prepare_bases(self, pending, lanes) -> np.ndarray:
+        """Set / recenter per-lane price bases so every ADMITTED price in
+        `pending` is representable on device. Runs before packing;
+        recentering shifts the lane's resting prices on device (rare — only
+        when flow drifts more than REBASE_LIMIT ticks from the current
+        base).
+
+        Returns a boolean drop mask aligned with `pending`: True marks a
+        DEL whose price is unrepresentable under the lane's (possibly just
+        recentred) base. Only ADD limit prices feed the grow-only envelope —
+        a DEL price is a lookup key, not an admission (a wrong-price cancel
+        is in-contract and must miss, engine.go:92-98; the stock delorder
+        client hardcodes price 0.5). Since every RESTING price always fits
+        the window, an unrepresentable DEL provably matches nothing, so it
+        is dropped host-side as a missed cancel instead of widening the
+        envelope and poisoning the lane forever."""
+        n = len(pending)
+        drop = np.zeros(n, bool)
         if not self._rebase:
-            return
+            return drop
         from ..types import OrderType
 
         lo: dict[int, int] = {}
         hi: dict[int, int] = {}
         for (_, o), lane in zip(pending, lanes):
-            if o.order_type is OrderType.MARKET:
-                # Price is documented-ignored for MARKET (types.py): it must
-                # not poison the lane's price envelope (a Price:0 market
-                # order would otherwise widen it past the int32 window
-                # forever). encode zeroes the device price too.
+            if o.action is not Action.ADD or o.order_type is OrderType.MARKET:
+                # MARKET prices are documented-ignored (encoded 0); DEL/NOP
+                # prices never admit a resting order. Neither may widen the
+                # envelope.
                 continue
             p = o.price
             l = lo.get(lane)
@@ -282,35 +368,52 @@ class BatchEngine:
                 elif p > hi[lane]:
                     hi[lane] = p
         for lane, l in lo.items():
-            h = hi[lane]
-            if not self._base_set[lane]:
-                nb = (l + h) // 2
-                if max(h - nb, nb - l) > self._INT32_SAFE:
-                    raise CapacityError(
-                        f"lane {lane}: batch price range [{l}, {h}] spans "
-                        "more than 2^31 ticks — int32 books cannot window "
-                        "it; use coarser ticks or an int64 BookConfig"
-                    )
-                self.price_base[lane] = nb
-                self._base_set[lane] = True
-                self._env_lo[lane] = l
-                self._env_hi[lane] = h
-                continue
-            self._env_lo[lane] = min(self._env_lo[lane], l)
-            self._env_hi[lane] = max(self._env_hi[lane], h)
-            b = int(self.price_base[lane])
-            if max(abs(l - b), abs(h - b)) <= self.REBASE_LIMIT:
-                continue
-            el, eh = int(self._env_lo[lane]), int(self._env_hi[lane])
+            self._admit_lane_range(lane, l, hi[lane])
+        for i, ((_, o), lane) in enumerate(zip(pending, lanes)):
+            if o.action is Action.DEL and (
+                abs(o.price - int(self.price_base[lane])) > self._INT32_SAFE
+            ):
+                drop[i] = True
+        return drop
+
+    def _admit_lane_range(self, lane: int, l: int, h: int) -> None:
+        """Admit the ADD-limit price range [l, h] into `lane`'s grow-only
+        envelope, seeding or recentering the base as needed. Shared by the
+        object packer (_prepare_bases) and the vectorized frame path
+        (engine.frames). Raises CapacityError — committing NOTHING — when
+        the admitted envelope cannot fit an int32 window."""
+        if not self._base_set[lane]:
+            nb = (l + h) // 2
+            if max(h - nb, nb - l) > self._INT32_SAFE:
+                raise CapacityError(
+                    f"lane {lane}: batch price range [{l}, {h}] spans "
+                    "more than 2^31 ticks — int32 books cannot window "
+                    "it; use coarser ticks or an int64 BookConfig"
+                )
+            self.price_base[lane] = nb
+            self._base_set[lane] = True
+            self._env_lo[lane] = l
+            self._env_hi[lane] = h
+            return
+        el = min(int(self._env_lo[lane]), l)
+        eh = max(int(self._env_hi[lane]), h)
+        b = int(self.price_base[lane])
+        if max(abs(l - b), abs(h - b)) > self.REBASE_LIMIT:
             nb = (el + eh) // 2
             if max(eh - nb, nb - el) > self._INT32_SAFE:
                 raise CapacityError(
-                    f"lane {lane}: admitted price range [{el}, {eh}] spans "
-                    "more than 2^31 ticks — int32 books cannot window it; "
-                    "use coarser ticks or an int64 BookConfig"
+                    f"lane {lane}: admitted price range [{el}, {eh}] "
+                    "spans more than 2^31 ticks — int32 books cannot "
+                    "window it; use coarser ticks or an int64 BookConfig"
                 )
             self._shift_lane_prices(lane, b - nb)
             self.price_base[lane] = nb
+        # Commit the envelope only after every check passed: a raised
+        # batch leaves no trace (the device books are unchanged too), so
+        # retrying without the offending order cannot inherit a widened
+        # window.
+        self._env_lo[lane] = el
+        self._env_hi[lane] = eh
 
     def _shift_lane_prices(self, lane: int, delta: int) -> None:
         """Recenter: stored rebased price -> absolute - new_base =
@@ -345,24 +448,67 @@ class BatchEngine:
             self.stats.lane_growths += 1
         return lane
 
+    def _checkpoint(self):
+        """Everything a failed batch must roll back: the device book stack
+        (immutable on device — retaining the reference is free) plus the
+        host-side rebasing state and geometry that packing mutates. Interner
+        growth is deliberately NOT rolled back (grow-only and idempotent:
+        a replay re-interns the same strings to the same ids, and restored
+        books only reference ids that already existed)."""
+        return (
+            self.books, self.config, self.n_slots,
+            self.price_base.copy(), self._base_set.copy(),
+            self._env_lo.copy(), self._env_hi.copy(),
+        )
+
+    def _restore(self, cp) -> None:
+        (
+            self.books, self.config, self.n_slots,
+            self.price_base, self._base_set, self._env_lo, self._env_hi,
+        ) = cp
+
     def process(self, orders: list[Order]) -> list[MatchResult]:
         """Apply a micro-batch. Symbols with more than max_t ops are drained
         over several device calls (order preserved); returns all events in
         original arrival order. Device-budget overflows are escalated
-        internally (see module docstring) — results are always exact."""
-        pending = [(i, o) for i, o in enumerate(orders)]
+        internally (see module docstring) — results are always exact.
+
+        Transactional: a raised batch rolls the engine back to its pre-batch
+        state (multi-grid batches commit device books per grid — without the
+        rollback, replaying a batch that failed on grid 2 would double-apply
+        grid 1's orders)."""
+        return [ev for _, evs in self.process_indexed(list(enumerate(orders))) for ev in evs]
+
+    def process_indexed(
+        self, indexed: list[tuple[int, Order]]
+    ) -> list[tuple[int, list[MatchResult]]]:
+        """process() keyed by caller-assigned arrival tags: each input item
+        is (tag, order) and the result is (tag, events) groups sorted by
+        tag. The sharded engine (gome_tpu.parallel.router) passes GLOBAL
+        arrival indices here so per-shard results merge back into the exact
+        single-FIFO emission order of the reference consumer
+        (rabbitmq.go:116-125). Same transactional rollback as process()."""
+        cp = self._checkpoint()
+        try:
+            return self._process_indexed(indexed)
+        except Exception:
+            self._restore(cp)
+            raise
+
+    def _process_indexed(self, indexed):
+        pending = list(indexed)
         decoded: list[tuple[int, list[MatchResult]]] = []
         while pending:
             pending = self._one_grid(pending, decoded)
         decoded.sort(key=lambda kv: kv[0])
-        self.stats.orders += len(orders)
-        events = [ev for _, evs in decoded for ev in evs]
-        for ev in events:
-            if ev.is_cancel:
-                self.stats.cancels += 1
-            else:
-                self.stats.fills += 1
-        return events
+        self.stats.orders += len(indexed)
+        for _, evs in decoded:
+            for ev in evs:
+                if ev.is_cancel:
+                    self.stats.cancels += 1
+                else:
+                    self.stats.fills += 1
+        return decoded
 
     def _pack_grid(self, pending):
         """Pack a pending (arrival, order) list into one [S, max_t] op grid.
@@ -374,14 +520,19 @@ class BatchEngine:
         # lanes pack into THIS grid rather than deferring to an extra
         # device call.
         lanes = [self._lane(order.symbol) for _, order in pending]
-        self._prepare_bases(pending, lanes)
+        drop = self._prepare_bases(pending, lanes)
         grid = _nop_grid(self.config, self.n_slots, self.max_t)
         contexts: dict[tuple[int, int], tuple[int, Order]] = {}
         fill_level: dict[int, int] = {}
         leftover: list[tuple[int, Order]] = []
         blocked: set[int] = set()  # lanes whose FIFO order must not be broken
 
-        for (arrival, order), lane in zip(pending, lanes):
+        for (arrival, order), lane, dropped in zip(pending, lanes, drop):
+            if dropped:
+                # Unrepresentable DEL price (see _prepare_bases): provably a
+                # miss; never reaches the device.
+                self.stats.cancels_missed += 1
+                continue
             t = fill_level.get(lane, 0)
             if lane in blocked or t >= self.max_t:
                 # Lane's time axis is full: defer, and block the lane so
@@ -407,7 +558,16 @@ class BatchEngine:
         (gome_tpu.engine.events) instead of MatchResult objects — the
         vectorized decode path that keeps the host in step with the device
         kernel's throughput. Identical event content and global order to
-        process(); stats are updated the same way."""
+        process(); stats are updated the same way. Transactional like
+        process(): a raised batch rolls back to pre-batch state."""
+        cp = self._checkpoint()
+        try:
+            return self._process_columnar(orders)
+        except Exception:
+            self._restore(cp)
+            raise
+
+    def _process_columnar(self, orders: list[Order]):
         from .events import EventBatch, empty_batch
 
         pending = [(i, o) for i, o in enumerate(orders)]
@@ -423,6 +583,9 @@ class BatchEngine:
             uid_table=self.uids.table,
         )
         if not batches:
+            # Nothing reached the device (e.g. every op was a dropped
+            # unrepresentable DEL): they are all missed cancels.
+            self.stats.cancels_missed += dels
             return empty_batch(**tables)
         cols = {
             n: np.concatenate([b[n] for b in batches]) for n in batches[0]
@@ -450,30 +613,69 @@ class BatchEngine:
         lanes = np.fromiter(
             (self._lane(o.symbol) for _, o in pending), np.int64, n
         )
-        self._prepare_bases(pending, lanes)
+        drop = self._prepare_bases(pending, lanes)
         bases = self.price_base[lanes]  # [N] int64
         # Slot within the lane = occurrence index (FIFO by construction:
-        # occurrence order == arrival order, and every op past max_t defers,
-        # so a lane's stream never reorders or splits across grids).
-        t = np.zeros(n, np.int64)
+        # occurrence order == arrival order, and every op past the grid's
+        # time depth defers, so a lane's stream never reorders or splits
+        # across grids). Dropped DELs (unrepresentable price,
+        # _prepare_bases) consume no slot and are neither packed nor
+        # deferred — the columnar missed-cancel accounting (dels - cancel
+        # events) covers them.
+        t = np.full(n, -1, np.int64)
         level: dict[int, int] = {}
         for i, lane in enumerate(lanes):
+            if drop[i]:
+                continue
             c = level.get(lane, 0)
             t[i] = c
             level[lane] = c + 1
-        packed = t < self.max_t
+
+        # Grid geometry: when the batch touches few of the provisioned
+        # lanes, pack a compact grid over just the live lanes (row ->
+        # lane indirection, executed by dense_batch_step); row and time
+        # axes bucket to powers of two to bound compile shapes. The full
+        # [n_slots, max_t] grid remains for wide batches and under a mesh
+        # (a cross-shard gather would need collectives).
+        live = (
+            np.unique(lanes[~drop]) if bool((~drop).any())
+            else np.zeros(0, np.int64)
+        )
+        use_dense = (
+            self.dense
+            and self.mesh is None
+            and len(live) > 0
+            and max(8, _next_pow2(len(live))) < self.n_slots
+        )
+        if use_dense:
+            row = np.searchsorted(live, lanes)
+            # Min 8 rows: the Pallas kernel's sublane-alignment floor, and
+            # padding rows cost nothing (sentinel gather/drop).
+            n_rows = max(8, _next_pow2(len(live)))
+            t_grid = min(
+                _next_pow2(max(level.values())),
+                max(self.dense_t_max, self.max_t),
+            )
+            lane_ids = np.full(n_rows, self.n_slots, np.int64)
+            lane_ids[: len(live)] = live
+        else:
+            row = lanes
+            n_rows = self.n_slots
+            t_grid = self.max_t
+            lane_ids = None
+        packed = (t >= 0) & (t < t_grid)
 
         oids, uids = self.oids, self.uids
         table = np.empty((n, 7), np.int64)
         for i, (_, o) in enumerate(pending):
-            row = table[i]
-            row[0] = int(o.action)
-            row[1] = int(o.side)
-            row[2] = o.order_type is OrderType.MARKET
-            row[3] = o.price
-            row[4] = o.volume
-            row[5] = oids.intern(o.oid)
-            row[6] = uids.intern(o.uuid)
+            rec = table[i]
+            rec[0] = int(o.action)
+            rec[1] = int(o.side)
+            rec[2] = o.order_type is OrderType.MARKET
+            rec[3] = o.price
+            rec[4] = o.volume
+            rec[5] = oids.intern(o.oid)
+            rec[6] = uids.intern(o.uuid)
         adds = packed & (table[:, 0] == int(Action.ADD))
         bad = adds & (table[:, 4] <= 0)
         if bad.any():
@@ -494,8 +696,8 @@ class BatchEngine:
                     "use coarser lot units or an int64 BookConfig"
                 )
 
-        grid = _nop_grid(self.config, self.n_slots, self.max_t)
-        pl, pt = lanes[packed], t[packed]
+        grid = _nop_grid(self.config, n_rows, t_grid)
+        pl, pt = row[packed], t[packed]
         for col, name in enumerate(
             ("action", "side", "is_market", "price", "volume", "oid", "uid")
         ):
@@ -509,7 +711,8 @@ class BatchEngine:
                 )
             grid[name][pl, pt] = vals
         meta = {
-            "lane": pl,
+            "lane": lanes[packed],
+            "row": pl,
             "t": pt,
             "arrival": np.fromiter(
                 (a for (a, _), p in zip(pending, packed) if p),
@@ -523,24 +726,27 @@ class BatchEngine:
             "oid_id": table[packed, 5],
             "uid_id": table[packed, 6],
         }
-        leftover = [pending[i] for i in np.nonzero(~packed)[0]]
-        return DeviceOp(**grid), meta, leftover
+        leftover = [pending[i] for i in np.nonzero(~packed & ~drop)[0]]
+        return DeviceOp(**grid), meta, leftover, lane_ids
 
     def _one_grid_columnar(self, pending, batches):
         from .events import decode_grid_columnar
 
-        ops, meta, leftover = self._pack_grid_vectorized(pending)
-        # _run_exact keys escalation bookkeeping by (lane, t); give it the
+        ops, meta, leftover, lane_ids = self._pack_grid_vectorized(pending)
+        if len(meta["arrival"]) == 0:
+            # Everything dropped (unrepresentable DELs): nothing to run.
+            return leftover
+        # _run_exact keys escalation bookkeeping by (row, t); give it the
         # packed coordinates.
         contexts = {
-            (int(l), int(tt)): None for l, tt in zip(meta["lane"], meta["t"])
+            (int(r), int(tt)): None for r, tt in zip(meta["row"], meta["t"])
         }
-        outs, lane_overrides = self._run_exact(ops, contexts)
+        outs, lane_overrides = self._run_exact(ops, contexts, lane_ids)
 
-        def outs_at(field, lanes, ts):
-            base = np.asarray(getattr(outs, field))[lanes, ts]
-            for lane, src in lane_overrides.items():
-                m = lanes == lane
+        def outs_at(field, rows, ts):
+            base = np.asarray(getattr(outs, field))[rows, ts]
+            for r, src in lane_overrides.items():
+                m = rows == r
                 if not m.any():
                     continue
                 ov = np.asarray(getattr(src, field))[ts[m]]
@@ -561,6 +767,9 @@ class BatchEngine:
 
     def _one_grid(self, pending, decoded):
         ops, contexts, leftover = self._pack_grid(pending)
+        if not contexts:
+            # Everything dropped (unrepresentable DELs): nothing to run.
+            return leftover
         outs, lane_overrides = self._run_exact(ops, contexts)
         for (lane, t), (arrival, order) in contexts.items():
             src = lane_overrides.get(lane)
@@ -580,14 +789,20 @@ class BatchEngine:
             decoded.append((arrival, events))
         return leftover
 
-    def _run_exact(self, ops: DeviceOp, contexts):
+    def _run_exact(self, ops: DeviceOp, contexts, lane_ids=None):
         """Run one grid, escalating device budgets until nothing overflowed.
 
-        Returns (outs, lane_overrides): the committed [S, T] outputs plus,
-        for lanes whose fill records were truncated at the grid's K, a
+        Returns (outs, lane_overrides): the committed [R, T] outputs plus,
+        for rows whose fill records were truncated at the grid's K, a
         re-decoded [T] StepOutput with a large-enough record budget.
+
+        lane_ids: for a dense grid, the [R] row -> lane mapping (sentinel
+        >= n_slots on padding rows); None for full grids (row == lane).
         """
         books_before = self.books  # immutable on device; cheap to retain
+
+        def lane_of(row: int) -> int:
+            return row if lane_ids is None else int(lane_ids[row])
 
         # Phase 1: book capacity. A tripped `book_overflow` means a resting
         # insert was dropped — the book state is NOT what the sequential
@@ -597,17 +812,27 @@ class BatchEngine:
         # before replaying — current resting count plus the ADDs packed into
         # the lane — so escalation costs one replay, not a doubling loop.
         while True:
-            new_books, outs = self._step(books_before, ops)
+            new_books, outs = self._step(books_before, ops, lane_ids)
             self.stats.device_calls += 1
             host_flags = np.asarray(jax.device_get(outs.book_overflow))
             if not host_flags.any():
                 break
             self.stats.cap_escalations += 1
             counts = np.asarray(jax.device_get(books_before.count))  # [S, 2]
-            adds_per_lane = np.sum(
+            adds_per_row = np.sum(
                 np.asarray(ops.action) == ACTION_ADD, axis=1
-            )  # [S]
-            bound = int((counts.max(axis=1) + adds_per_lane).max())
+            )  # [R]
+            if lane_ids is None:
+                row_counts = counts.max(axis=1)
+            else:
+                ids = np.asarray(lane_ids)
+                valid = ids < counts.shape[0]
+                row_counts = np.where(
+                    valid,
+                    counts.max(axis=1)[np.clip(ids, 0, counts.shape[0] - 1)],
+                    0,
+                )
+            bound = int((row_counts + adds_per_row).max())
             new_cap = _next_pow2(max(bound, self.config.cap + 1))
             if new_cap > self.max_cap:
                 raise CapacityError(
@@ -622,34 +847,55 @@ class BatchEngine:
 
         # Phase 2: fill records. n_fills > K truncated this op's *records*
         # only — the book transition is exact either way — so re-run just the
-        # affected lanes from the snapshot with K' >= max fills observed.
+        # affected rows from the snapshot with K' >= max fills observed.
         # n_fills <= resting orders crossed <= cap, so K' <= cap and the
         # set of escalated compile shapes is bounded by log2(cap).
         lane_overrides: dict[int, StepOutput] = {}
         n_fills = np.asarray(outs.n_fills)
         overflowed = sorted(
             {
-                lane
-                for (lane, t) in contexts
-                if n_fills[lane, t] > self.config.max_fills
+                row
+                for (row, t) in contexts
+                if n_fills[row, t] > self.config.max_fills
             }
         )
-        for lane in overflowed:
+        for row in overflowed:
             self.stats.fill_record_escalations += 1
-            k = min(_next_pow2(int(n_fills[lane].max())), self.config.cap)
+            k = min(_next_pow2(int(n_fills[row].max())), self.config.cap)
             big = dataclasses.replace(self.config, max_fills=k)
+            lane = lane_of(row)
             lane_book = jax.tree.map(lambda a: a[lane], books_before)
-            lane_ops = jax.tree.map(lambda a: a[lane], ops)
+            lane_ops = jax.tree.map(lambda a: a[row], ops)
             _, lane_out = lane_scan(big, lane_book, lane_ops)
             self.stats.device_calls += 1
-            lane_overrides[lane] = jax.device_get(lane_out)
+            lane_overrides[row] = jax.device_get(lane_out)
         return outs, lane_overrides
 
-    def _step(self, books: BookState, ops: DeviceOp):
-        """Run one [S, T] grid with the configured kernel. The Pallas path
+    def _step(self, books: BookState, ops: DeviceOp, lane_ids=None):
+        """Run one [R, T] grid with the configured kernel. lane_ids selects
+        the dense gather/scatter step (compact grid over live lanes; never
+        under a mesh — the packer guarantees that). The Pallas path
         requires S % block_s == 0 (n_slots growth keeps powers of two) and
         interprets off-TPU; escalation re-runs (lane_scan) stay on the scan
         path — they are rare and per-lane."""
+        if lane_ids is not None:
+            ids = jnp.asarray(lane_ids, jnp.int32)
+            if self.kernel == "pallas":
+                from ..ops import default_block_s, pallas_available
+
+                r = ops.action.shape[0]
+                block_s = default_block_s(r)
+                if self._pallas_interpret and block_s is None:
+                    block_s = next(b for b in (8, 1) if r % b == 0)
+                if block_s is not None and (
+                    pallas_available(self.config.dtype)
+                    or self._pallas_interpret
+                ):
+                    return dense_kernel_step(
+                        self.config, books, ids, ops, block_s,
+                        not pallas_available(self.config.dtype),
+                    )
+            return dense_batch_step(self.config, books, ids, ops)
         if self.mesh is not None:
             from ..parallel.mesh import shard_batch, sharded_batch_step
 

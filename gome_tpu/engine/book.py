@@ -119,8 +119,23 @@ def ensure_dtype_usable(dtype) -> None:
     """int64 books silently degrade to int32 when jax's x64 mode is off —
     wrong matching arithmetic (depth prefix sums overflow), not an error.
     Enable x64 on the user's behalf (with a warning, since it is global
-    config) rather than let that happen."""
+    config) rather than let that happen.
+
+    Exception: once the Pallas kernel module has traced anything, flipping
+    jax_enable_x64 mid-process can send a later retrace into infinite
+    recursion through the dtype-promotion cache (documented in
+    scripts/fuzz.py, observed on TPU). In that state the flip is refused
+    with an actionable error instead — set JAX_ENABLE_X64=1 before startup."""
     if jnp.dtype(dtype).itemsize == 8 and not jax.config.jax_enable_x64:
+        import sys
+
+        if "gome_tpu.ops.pallas_match" in sys.modules:
+            raise RuntimeError(
+                "BookConfig dtype is 64-bit but jax_enable_x64 is off, and "
+                "the Pallas kernel module is already loaded — flipping x64 "
+                "now can corrupt jax's trace caches. Set JAX_ENABLE_X64=1 "
+                "before process start (or use an int32 BookConfig)."
+            )
         import warnings
 
         warnings.warn(

@@ -175,16 +175,19 @@ def decode_grid_columnar(ops_meta: dict, outs_at) -> dict[str, np.ndarray]:
     once per micro-batch, not per grid).
 
     ops_meta: parallel numpy arrays describing the ops that were packed into
-    the grid — lane, t, arrival, side, price, is_market, action, oid_id,
-    uid_id (all [N] for N packed ops).
-    outs_at(field, lanes, ts) -> numpy values of StepOutput `field` at those
-    (lane, t) coordinates ([N] or [N, K]); indirection so the caller can
-    splice in per-lane escalation re-runs.
+    the grid — lane (the engine lane, for symbol ids), row (the grid row —
+    equal to lane on full grids, the compact dense-grid row otherwise), t,
+    arrival, side, price, is_market, action, oid_id, uid_id (all [N] for N
+    packed ops).
+    outs_at(field, rows, ts) -> numpy values of StepOutput `field` at those
+    (row, t) coordinates ([N] or [N, K]); indirection so the caller can
+    splice in per-row escalation re-runs.
 
     Returns columns sorted by (arrival, fill index) — the reference's global
     emission order.
     """
     lane = ops_meta["lane"]
+    row = ops_meta.get("row", lane)
     t = ops_meta["t"]
     arrival = ops_meta["arrival"]
     action = ops_meta["action"]
@@ -193,10 +196,10 @@ def decode_grid_columnar(ops_meta: dict, outs_at) -> dict[str, np.ndarray]:
     is_del = action == int(Action.DEL)
 
     # --- fills: one event per (ADD op, record j < n_fills) ---------------
-    n_fills = np.where(is_add, outs_at("n_fills", lane, t), 0)  # [N]
+    n_fills = np.where(is_add, outs_at("n_fills", row, t), 0)  # [N]
     k = int(n_fills.max()) if len(n_fills) else 0
     if k:
-        rec = lambda f: outs_at(f, lane, t)[:, :k]  # [N, K']
+        rec = lambda f: outs_at(f, row, t)[:, :k]  # [N, K']
         jj = np.arange(k)
         mask = jj[None, :] < n_fills[:, None]  # [N, K']
         src, j = np.nonzero(mask)  # event -> (op row, record j), arrival-major
@@ -230,7 +233,7 @@ def decode_grid_columnar(ops_meta: dict, outs_at) -> dict[str, np.ndarray]:
         fills = {n: np.zeros(0, dt) for n, dt in _COLUMNS}
 
     # --- cancels: one event per found DEL --------------------------------
-    found = is_del & (outs_at("cancel_found", lane, t) != 0)
+    found = is_del & (outs_at("cancel_found", row, t) != 0)
     (csrc,) = np.nonzero(found)
     cancels = {
         "arrival": arrival[csrc],
@@ -240,11 +243,11 @@ def decode_grid_columnar(ops_meta: dict, outs_at) -> dict[str, np.ndarray]:
         "taker_oid": ops_meta["oid_id"][csrc],
         "taker_side": ops_meta["side"][csrc].astype(np.int8),
         "taker_price": ops_meta["price"][csrc],
-        "taker_volume": outs_at("cancel_volume", lane, t)[csrc],
+        "taker_volume": outs_at("cancel_volume", row, t)[csrc],
         "maker_uid": ops_meta["uid_id"][csrc],
         "maker_oid": ops_meta["oid_id"][csrc],
         "fill_price": ops_meta["price"][csrc],
-        "maker_volume": outs_at("cancel_volume", lane, t)[csrc],
+        "maker_volume": outs_at("cancel_volume", row, t)[csrc],
         "match_volume": np.zeros(len(csrc), np.int64),
         "is_market": np.zeros(len(csrc), np.bool_),
     }

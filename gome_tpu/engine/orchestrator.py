@@ -60,13 +60,36 @@ class MatchEngine:
         if order.action is Action.ADD:
             self.pre_pool.add(self._prekey(order))
 
+    def unmark(self, order: Order) -> None:
+        """Discard an order's pre-pool entry without processing it — the
+        consumer's dead-letter path uses this so a poisoned ADD's restored
+        mark does not linger forever (and leak into snapshots)."""
+        self.pre_pool.discard(self._prekey(order))
+
     # -- consumer side -----------------------------------------------------
     def process(self, orders: list[Order]) -> list[MatchResult]:
         """Apply one micro-batch in arrival order; returns the MatchResult
         event stream in the reference's global emission order. Admission
         (the pre-pool check, engine.go:58-62) drops ADDs cancelled before
         consumption without touching the book."""
-        return self.batch.process(self._admit(orders))
+        return [
+            ev
+            for _, evs in self.process_indexed(list(enumerate(orders)))
+            for ev in evs
+        ]
+
+    def process_indexed(
+        self, indexed: list[tuple[int, Order]]
+    ) -> list[tuple[int, list[MatchResult]]]:
+        """process() keyed by caller-assigned arrival tags (see
+        BatchEngine.process_indexed) — admission applies identically; tags
+        of dropped ADDs simply emit no group."""
+        admitted, consumed = self._admit(indexed)
+        try:
+            return self.batch.process_indexed(admitted)
+        except Exception:
+            self.pre_pool |= consumed
+            raise
 
     def process_one(self, order: Order) -> list[MatchResult]:
         return self.process([order])
@@ -76,23 +99,41 @@ class MatchEngine:
         event content/order, but returns a columnar EventBatch
         (gome_tpu.engine.events) — the shape the consumer publishes from
         without building per-event objects."""
-        return self.batch.process_columnar(self._admit(orders))
+        admitted, consumed = self._admit(list(enumerate(orders)))
+        try:
+            return self.batch.process_columnar([o for _, o in admitted])
+        except Exception:
+            self.pre_pool |= consumed
+            raise
 
-    def _admit(self, orders: list[Order]) -> list[Order]:
-        admitted: list[Order] = []
-        for order in orders:
+    def _admit(
+        self, indexed: list[tuple[int, Order]]
+    ) -> tuple[list[tuple[int, Order]], set]:
+        """Apply admission over (tag, order) items; also returns the
+        pre-pool keys this batch consumed so a FAILED batch can restore them
+        (process/_columnar do) — the at-least-once consumer replays failed
+        batches, and a replayed ADD must not die as unmarked just because
+        the failed attempt already popped its key."""
+        admitted: list[tuple[int, Order]] = []
+        consumed: set[tuple[str, str, str]] = set()
+        for item in indexed:
+            order = item[1]
             if order.action is Action.ADD:
                 key = self._prekey(order)
                 if key not in self.pre_pool:
                     self.stats.dropped_no_prepool += 1
                     continue
                 self.pre_pool.discard(key)
-                admitted.append(order)
+                consumed.add(key)
+                admitted.append(item)
             elif order.action is Action.DEL:
-                self.pre_pool.discard(self._prekey(order))
-                admitted.append(order)
+                key = self._prekey(order)
+                if key in self.pre_pool:
+                    self.pre_pool.discard(key)
+                    consumed.add(key)
+                admitted.append(item)
             # NOP padding never reaches the device.
-        return admitted
+        return admitted, consumed
 
     # -- views -------------------------------------------------------------
     @property

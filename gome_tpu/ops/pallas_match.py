@@ -84,10 +84,19 @@ def default_block_s(s: int) -> int | None:
     return None
 
 
-def _kernel(config: BookConfig, t_len: int, *refs):
+def _kernel(config: BookConfig, t_block: int, *refs):
     """refs: 12 book-in (5 buy rows, 5 sale rows, count, next_seq) +
     1 op-pack-in + 12 book-out + 5 record-out + 1 scalar-pack-out.
-    See module docstring for layouts."""
+    See module docstring for layouts.
+
+    The grid is (lane blocks, time blocks): the book blocks' index maps
+    ignore the time-block index, so each lane block's books stay RESIDENT
+    in VMEM across the whole time sweep (Pallas revisited-block semantics;
+    time is the innermost grid dim), while op/record/scalar blocks page
+    through t_block-deep windows — VMEM cost is O(t_block), not O(T), so
+    a hot symbol can run thousands of ops deep in one kernel launch. At
+    time block 0 the input books seed the output refs; afterwards the
+    carry lives in the output refs."""
     (bb_p, bb_l, bb_s, bb_o, bb_u, sb_p, sb_l, sb_s, sb_o, sb_u,
      cnt, nsq, ops,
      ob_p, ob_l, ob_s, ob_o, ob_u, os_p, os_l, os_s, os_o, os_u,
@@ -95,13 +104,22 @@ def _kernel(config: BookConfig, t_len: int, *refs):
      fp, mo, mu, mp, mr, scal) = refs
     rec_refs = (fp, mo, mu, mp, mr)
 
-    buy = _Side(bb_p[...], bb_l[...], bb_s[...], bb_o[...], bb_u[...])
-    sale = _Side(sb_p[...], sb_l[...], sb_s[...], sb_o[...], sb_u[...])
-    counts = cnt[...]  # [B, 2]
+    @pl.when(pl.program_id(1) == 0)
+    def _seed():
+        for dst, src in (
+            (ob_p, bb_p), (ob_l, bb_l), (ob_s, bb_s), (ob_o, bb_o),
+            (ob_u, bb_u), (os_p, sb_p), (os_l, sb_l), (os_s, sb_s),
+            (os_o, sb_o), (os_u, sb_u), (ocnt, cnt), (onsq, nsq),
+        ):
+            dst[...] = src[...]
+
+    buy = _Side(ob_p[...], ob_l[...], ob_s[...], ob_o[...], ob_u[...])
+    sale = _Side(os_p[...], os_l[...], os_s[...], os_o[...], os_u[...])
+    counts = ocnt[...]  # [B, 2]
     # Loop carries stay rank-2: Mosaic's layout inference crashes on rank-1
     # vectors carried through fori_loop (layout.h implicit-dim check); the
     # [B, 1] squeeze/unsqueeze inside the body is free.
-    carry = (buy, sale, counts[:, 0:1], counts[:, 1:2], nsq[...])
+    carry = (buy, sale, counts[:, 0:1], counts[:, 1:2], onsq[...])
 
     step = jax.vmap(
         lambda b, a, nb, ns, nq, o: step_rows_impl(config, b, a, nb, ns, nq, o)
@@ -139,7 +157,7 @@ def _kernel(config: BookConfig, t_len: int, *refs):
         scal[pl.ds(t, 1)] = s[None]
         return buy, sale, nb[:, None], ns[:, None], nq[:, None]
 
-    buy, sale, nb, ns, nq = jax.lax.fori_loop(0, t_len, body, carry)
+    buy, sale, nb, ns, nq = jax.lax.fori_loop(0, t_block, body, carry)
     for ref, v in zip((ob_p, ob_l, ob_s, ob_o, ob_u), buy):
         ref[...] = v
     for ref, v in zip((os_p, os_l, os_s, os_o, os_u), sale):
@@ -152,7 +170,9 @@ def _kernel(config: BookConfig, t_len: int, *refs):
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0,), static_argnames=("block_s", "interpret")
+    jax.jit,
+    static_argnums=(0,),
+    static_argnames=("block_s", "interpret", "block_t"),
 )
 def pallas_batch_step(
     config: BookConfig,
@@ -160,16 +180,30 @@ def pallas_batch_step(
     ops: DeviceOp,
     block_s: int = 128,
     interpret: bool = False,
+    block_t: int | None = None,
 ) -> tuple[BookState, StepOutput]:
     """Drop-in replacement for engine.batch.batch_step with identical
     semantics (books [S, ...], ops [S, T] -> books', outs [S, T, ...]).
     S must be a multiple of block_s (callers pad lanes; NOP rows are free),
     and the compiled path needs block_s to be a multiple of 128 (the packed
     op/record/scalar blocks put the symbol axis on the lane dim).
+
+    block_t: time-block depth (must divide T; default min(T, 64)). Books
+    stay VMEM-resident across the time sweep while op/record windows page
+    in t_block-deep blocks, so VMEM cost is O(block_t) and deep time axes
+    (hot-symbol dense grids, engine/batch.py) fit at any T.
     """
     s, t_len = ops.action.shape
+    if block_t is None:
+        # Largest divisor of T that is <= 64: bounds VMEM without imposing
+        # any divisibility constraint on callers' time depths.
+        block_t = min(t_len, 64)
+        while t_len % block_t:
+            block_t -= 1
     if s % block_s != 0:
         raise ValueError(f"S={s} not a multiple of block_s={block_s}")
+    if t_len % block_t != 0:
+        raise ValueError(f"T={t_len} not a multiple of block_t={block_t}")
     if not interpret and not (
         block_s % 128 == 0 or (block_s == s and block_s % 8 == 0)
     ):
@@ -190,22 +224,25 @@ def pallas_batch_step(
             "compiled pallas kernel is int32-only (no Mosaic 64-bit "
             "lowering); use the scan path (or interpret=True) for int64"
         )
-    grid = (s // block_s,)
+    grid = (s // block_s, t_len // block_t)
 
     def bspec(*shape):
         # Symbol-major blocks: block i covers rows [i*block_s, ...) and the
-        # full extent of every trailing axis.
+        # full extent of every trailing axis. The time-block index j is
+        # IGNORED — time is the innermost grid dim, so the block is
+        # revisited and stays VMEM-resident across the whole time sweep.
         nd = len(shape)
         return pl.BlockSpec(
-            (block_s,) + shape, lambda i, _nd=nd: (i,) + (0,) * _nd
+            (block_s,) + shape, lambda i, j, _nd=nd: (i,) + (0,) * _nd
         )
 
-    def tspec(*lead):
-        # Time-leading blocks [*lead, block_s] at block i (dynamic per-step
-        # access lands on the major dim; symbol block rides the lane dim).
-        nd = len(lead)
+    def tspec(mid):
+        # Time-paged blocks [block_t, mid, block_s] at (time block j, lane
+        # block i): dynamic per-step access lands on the major dim; the
+        # symbol block rides the lane dim; only a block_t-deep window is
+        # resident at a time.
         return pl.BlockSpec(
-            lead + (block_s,), lambda i, _nd=nd: (0,) * _nd + (i,)
+            (block_t, mid, block_s), lambda i, j: (j, 0, i)
         )
 
     row = lambda dtype: jax.ShapeDtypeStruct((s, cap), dtype)
@@ -217,8 +254,8 @@ def pallas_batch_step(
             jax.ShapeDtypeStruct((s, 1), sq),
         ]
     )
-    in_specs = book_specs + [tspec(t_len, 8)]
-    out_specs = book_specs + [tspec(t_len, k)] * 5 + [tspec(t_len, 8)]
+    in_specs = book_specs + [tspec(8)]
+    out_specs = book_specs + [tspec(k)] * 5 + [tspec(8)]
     out_shape = (
         book_shape
         + [jax.ShapeDtypeStruct((t_len, k, s), dt)] * 5
@@ -239,7 +276,7 @@ def pallas_batch_step(
     ]
 
     call = pl.pallas_call(
-        functools.partial(_kernel, config, t_len),
+        functools.partial(_kernel, config, block_t),
         grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,

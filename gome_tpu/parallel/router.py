@@ -75,7 +75,16 @@ class ShardedEngine:
     def mark(self, order: Order) -> None:
         self.shards[self.router.route(order.symbol)].mark(order)
 
+    def unmark(self, order: Order) -> None:
+        self.shards[self.router.route(order.symbol)].unmark(order)
+
     def process(self, orders: list[Order]) -> list[MatchResult]:
+        """Apply one micro-batch across shards; returns the event stream in
+        the EXACT single-FIFO global emission order of the reference
+        consumer (rabbitmq.go:116-125): each shard processes its sub-batch
+        tagged with global arrival indices (one device call per shard, full
+        batching preserved) and the per-order event groups merge back by
+        arrival."""
         by_shard: dict[int, list[tuple[int, Order]]] = {}
         for i, order in enumerate(orders):
             by_shard.setdefault(self.router.route(order.symbol), []).append(
@@ -83,43 +92,16 @@ class ShardedEngine:
             )
         merged: list[tuple[int, list[MatchResult]]] = []
         for shard_id, items in by_shard.items():
-            shard = self.shards[shard_id]
-            # per-shard sub-batch keeps arrival order within the shard
-            events = shard.process([o for _, o in items])
-            # re-associate: events arrive in the shard's emission order;
-            # split them back per originating order via the shard's
-            # stats-free contract is not available, so merge at the batch
-            # level: tag the whole shard result with the first arrival
-            # index of the sub-batch and interleave by arrival below.
-            merged.append((items[0][0], events))
-        # Global emission order: the reference's consumer is a single FIFO
-        # (rabbitmq.go:116-125), so cross-symbol order follows arrival
-        # order. Shard until-now boundaries make exact interleaving
-        # ambiguous only BETWEEN independent symbols, where any order is
-        # semantically equivalent (no shared state); we use sub-batch
-        # arrival rank for determinism.
+            merged.extend(self.shards[shard_id].process_indexed(items))
         merged.sort(key=lambda kv: kv[0])
         return [ev for _, evs in merged for ev in evs]
 
     def process_with_arrival_order(
         self, orders: list[Order]
     ) -> list[MatchResult]:
-        """Exact global-FIFO emission order (reference-equivalent): process
-        order-by-order batches per shard boundary crossing. Slower; used by
-        parity tests."""
-        events: list[MatchResult] = []
-        run: list[Order] = []
-        run_shard = -1
-        for order in orders:
-            s = self.router.route(order.symbol)
-            if s != run_shard and run:
-                events.extend(self.shards[run_shard].process(run))
-                run = []
-            run_shard = s
-            run.append(order)
-        if run:
-            events.extend(self.shards[run_shard].process(run))
-        return events
+        """Kept for API compatibility: process() itself now emits exact
+        global-FIFO order (per-order arrival tags), so this is an alias."""
+        return self.process(orders)
 
     @property
     def stats(self):

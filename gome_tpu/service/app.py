@@ -49,11 +49,14 @@ class EngineService:
             batch_n=e.max_t * max(1, e.n_slots // 8),
             on_batch=on_batch,
         )
+        from ..engine.step import LOT_MAX32
+
         self.gateway = OrderGateway(
             self.bus,
             accuracy=e.accuracy,
             mark=self.engine.mark,
             match_feed=self.feed,
+            max_volume=LOT_MAX32 if e.dtype == "int32" else None,
         )
         self._server = None
 

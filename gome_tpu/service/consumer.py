@@ -40,6 +40,10 @@ _batch_latency = REGISTRY.histogram(
 _throughput = REGISTRY.gauge(
     "gome_orders_per_second", "EWMA matching throughput"
 )
+_poisoned = REGISTRY.counter(
+    "gome_poison_orders_total",
+    "orders dead-lettered by the poison-batch policy",
+)
 
 
 class OrderConsumer:
@@ -50,12 +54,22 @@ class OrderConsumer:
         batch_n: int = 256,
         batch_wait_s: float = 0.002,
         on_batch=None,
+        poison_threshold: int = 3,
     ):
         self.engine = engine
         self.bus = bus
         self.batch_n = batch_n
         self.batch_wait_s = batch_wait_s
         self.on_batch = on_batch  # callback(n_orders, n_events): persist hook
+        # Poison-batch policy: a deterministic per-batch error (e.g. a lane
+        # CapacityError) would otherwise replay the same uncommitted offset
+        # forever and halt matching engine-wide. After `poison_threshold`
+        # consecutive failures at the SAME committed offset, the batch is
+        # replayed order-by-order and the offending orders dead-lettered
+        # (logged + counted) so the stream advances.
+        self.poison_threshold = poison_threshold
+        self._fail_offset = -1
+        self._fail_count = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -110,10 +124,87 @@ class OrderConsumer:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            self.step_with_policy()
+
+    def step_with_policy(self) -> int:
+        """One consumer step with the poison-batch policy applied. Returns
+        orders processed (0 on a failed or empty step). Never raises — the
+        consumer thread must survive any failure (the reference panics
+        instead; a transient bus outage must not kill matching)."""
+        try:
+            n = self.run_once()
+            self._fail_count = 0
+            return n
+        except Exception:  # keep consuming; reference panics instead
+            log.exception("order batch failed")
             try:
-                self.run_once()
-            except Exception:  # keep consuming; reference panics instead
-                log.exception("order batch failed")
+                offset = self.bus.order_queue.committed()
+                if offset == self._fail_offset:
+                    self._fail_count += 1
+                else:
+                    self._fail_offset, self._fail_count = offset, 1
+                if self._fail_count >= self.poison_threshold:
+                    self._fail_count = 0
+                    return self.quarantine_once()
+            except Exception:
+                log.exception("poison-batch policy step failed; will retry")
+            return 0
+
+    def quarantine_once(self) -> int:
+        """Replay the head batch one order at a time, dead-lettering each
+        order whose ENGINE processing deterministically fails (logged with
+        its wire body + counted in gome_poison_orders_total) and committing
+        past it — the stream advances even when an order poisons batch
+        processing. Healthy orders still match and publish normally.
+
+        A publish failure is NOT a poison order: the quarantine pass stops
+        without committing that offset (standard at-least-once replay — the
+        same window run_once has between processing and commit), so no
+        events are ever dead-lettered because the match queue hiccuped."""
+        msgs = self.bus.order_queue.poll_batch(self.batch_n, 0)
+        processed = 0
+        for m in msgs:
+            orders = []
+            try:
+                orders = decode_orders_batch([m.body])
+                try:
+                    batch = self.engine.process_columnar(orders)
+                except Exception:
+                    # Confirm determinism with one retry before discarding:
+                    # a transient fault (device hiccup) must not cost a
+                    # healthy order. The failed attempt rolled back.
+                    batch = self.engine.process_columnar(orders)
+            except Exception:
+                _poisoned.inc(1)
+                log.exception(
+                    "dead-lettering poison order at offset %d: %r",
+                    m.offset, m.body,
+                )
+                # The failed engine call restored its consumed pre-pool
+                # marks; a dead-lettered ADD will never be replayed, so its
+                # mark must not linger (it would persist into snapshots as
+                # a live queued ADD).
+                unmark = getattr(self.engine, "unmark", None)
+                if unmark is not None:
+                    for o in orders:
+                        unmark(o)
+                self.bus.order_queue.commit(m.offset + 1)
+                continue
+            try:
+                self.bus.match_queue.publish_batch(batch.to_json_lines())
+            except Exception:
+                log.exception(
+                    "publish failed during quarantine at offset %d; "
+                    "leaving offset for replay", m.offset,
+                )
+                return processed
+            self.bus.order_queue.commit(m.offset + 1)
+            processed += 1
+            _orders_total.inc(1)
+            _events_total.inc(len(batch))
+            if self.on_batch is not None:
+                self.on_batch(1, len(batch))
+        return processed
 
     def stop(self) -> None:
         self._stop.set()

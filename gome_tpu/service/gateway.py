@@ -51,20 +51,36 @@ def order_from_request(
 class OrderGateway:
     """The Order servicer (main.go:20,39-64)."""
 
-    def __init__(self, bus: QueueBus, accuracy: int, mark=None, match_feed=None):
+    def __init__(
+        self,
+        bus: QueueBus,
+        accuracy: int,
+        mark=None,
+        match_feed=None,
+        max_volume: int | None = None,
+    ):
         """mark: callable(Order) recording the pre-pool entry — the
         MatchEngine.mark bound method in single-binary mode. match_feed:
-        MatchFeed for SubscribeMatches (optional)."""
+        MatchFeed for SubscribeMatches (optional). max_volume: per-order lot
+        ceiling enforced at the edge (int32 engines pass LOT_MAX32 so an
+        oversized order is rejected with code 3 here, like volume<=0,
+        instead of raising inside the consumer batch)."""
         self._bus = bus
         self._accuracy = accuracy
         self._mark = mark or (lambda order: None)
         self._match_feed = match_feed
+        self._max_volume = max_volume
 
     def DoOrder(self, request: pb.OrderRequest, context) -> pb.OrderResponse:
         try:
             order = order_from_request(request, Action.ADD, self._accuracy)
             if order.volume <= 0:
                 raise ValueError("volume must be positive")
+            if self._max_volume is not None and order.volume > self._max_volume:
+                raise ValueError(
+                    f"volume {order.volume} exceeds the engine's per-order "
+                    f"lot ceiling {self._max_volume}"
+                )
             if order.order_type is OrderType.LIMIT and order.price <= 0:
                 raise ValueError("limit price must be positive")
         except ValueError as e:

@@ -19,20 +19,28 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-
-if "--tpu" not in sys.argv:
-    jax.config.update("jax_platforms", "cpu")
-# Enable x64 up front in BOTH modes: int64 cases would flip it mid-process
-# (engine.book.ensure_dtype_usable), and flipping jax_enable_x64 between
-# traced cases can send jax's dtype-promotion cache into infinite recursion
-# on a later pallas retrace (observed on TPU). Caveat: int32 SCAN-path cases
-# therefore fuzz under x64-on promotion, whereas the production bench runs
-# x64 off — the compiled-kernel trace is x64-immune (pallas_match pins the
-# flag off), and bench.py itself covers the x64-off scan configuration.
-jax.config.update("jax_enable_x64", True)
-
 import numpy as np
+
+
+def configure(tpu: bool = False) -> None:
+    """Set the jax global config the fuzz cases need. Called from main() —
+    NOT at import time, so importing this module (the CI slice in
+    tests/test_fuzz.py does) never mutates process-global jax state; the
+    test harness's conftest owns that configuration there.
+
+    Enable x64 up front in BOTH modes: int64 cases would flip it mid-process
+    (engine.book.ensure_dtype_usable), and flipping jax_enable_x64 between
+    traced cases can send jax's dtype-promotion cache into infinite
+    recursion on a later pallas retrace (observed on TPU). Caveat: int32
+    SCAN-path cases therefore fuzz under x64-on promotion, whereas the
+    production bench runs x64 off — the compiled-kernel trace is x64-immune
+    (pallas_match pins the flag off), and bench.py itself covers the x64-off
+    scan configuration."""
+    import jax
+
+    if not tpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
 
 
 def run_case(seed: int) -> str:
@@ -145,8 +153,10 @@ def run_case(seed: int) -> str:
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 30
-    seed0 = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    configure(tpu="--tpu" in sys.argv)
+    args = [a for a in sys.argv[1:] if a != "--tpu"]
+    n = int(args[0]) if len(args) > 0 else 30
+    seed0 = int(args[1]) if len(args) > 1 else 1000
     for s in range(seed0, seed0 + n):
         print(run_case(s), flush=True)
     print(f"ALL {n} CASES PASSED")

@@ -1,0 +1,245 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+  * a wrong-price cancel (in-contract — the stock delorder client hardcodes
+    price 0.5, gomengine/delorder.go) must never widen an int32 lane's
+    rebasing envelope or raise; it is a missed cancel (engine.go:92-98);
+  * a batch aborted by CapacityError must leave no trace — neither book
+    state nor the grow-only envelope;
+  * the gateway rejects orders over the int32 lot ceiling at the edge
+    (code 3, like volume<=0) instead of letting them poison consumer
+    batches;
+  * the consumer's poison-batch policy dead-letters a deterministically
+    failing order after N replays instead of halting matching forever, and
+    a failed batch restores the pre-pool marks it consumed so the replay
+    does not drop its ADDs.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gome_tpu.engine import BatchEngine, BookConfig
+from gome_tpu.engine.batch import CapacityError
+from gome_tpu.types import Action, Order, Side
+
+BTC = 10_000_000_000_000  # 1e13 ticks = $100k at accuracy 8
+WRONG = 50_000_000  # the stock delorder client's hardcoded 0.5 => 5e7 ticks
+
+
+def _cfg32(**kw):
+    return BookConfig(cap=32, max_fills=8, dtype=jnp.int32, **kw)
+
+
+def _add(oid, price, side=Side.BUY, volume=5, symbol="btc2usdt"):
+    return Order(
+        uuid="u", oid=oid, symbol=symbol, side=side, price=price,
+        volume=volume, action=Action.ADD,
+    )
+
+
+def _del(oid, price, side=Side.BUY, symbol="btc2usdt"):
+    return Order(
+        uuid="u", oid=oid, symbol=symbol, side=side, price=price,
+        volume=0, action=Action.DEL,
+    )
+
+
+@pytest.mark.parametrize("columnar", [False, True])
+def test_wrong_price_cancel_never_poisons_lane(columnar):
+    """ADVICE #1 (high): DEL at a price unrepresentable under the lane's
+    base is a missed cancel, not a CapacityError, and must not widen the
+    envelope — a later recenter still succeeds."""
+    eng = BatchEngine(_cfg32(), n_slots=2, max_t=32)
+    run = (
+        (lambda os: eng.process_columnar(os).to_results())
+        if columnar
+        else eng.process
+    )
+    # Seed the lane at BTC scale (base ~1e13).
+    assert run([_add("a", BTC, side=Side.SALE)]) == []
+    # The in-contract wrong-price cancel: |5e7 - 1e13| >> 2^31.
+    events = run([_del("a", WRONG)])
+    assert events == []
+    assert eng.stats.cancels_missed == 1
+    # Envelope must not contain the DEL price: a drift past REBASE_LIMIT
+    # forces a recenter which would raise forever had it been admitted.
+    drift = BatchEngine.REBASE_LIMIT + 100_000
+    events = run([_add("b", BTC + drift, side=Side.SALE)])
+    assert events == []
+    # The originally rested order is still cancellable at its true price.
+    events = run([_del("a", BTC, side=Side.SALE)])
+    assert len(events) == 1 and events[0].match_volume == 0
+    eng.batch.verify_books() if hasattr(eng, "batch") else eng.verify_books()
+
+
+def test_wrong_price_cancel_mid_batch_with_adds():
+    """The dropped DEL shares a batch with packable ops on the same lane —
+    packing must skip only the DEL (no slot consumed, no deferral loop)."""
+    eng = BatchEngine(_cfg32(), n_slots=2, max_t=32)
+    events = eng.process(
+        [
+            _add("a", BTC, side=Side.SALE, volume=5),
+            _del("a", WRONG),  # dropped: unrepresentable
+            _add("b", BTC, side=Side.BUY, volume=5),  # fills against a
+        ]
+    )
+    assert len(events) == 1 and events[0].match_volume == 5
+    assert eng.stats.cancels_missed == 1
+    eng.verify_books()
+
+
+def test_del_on_fresh_lane_with_huge_price():
+    """DEL on a lane with no base set and a price beyond int32: dropped as
+    a miss (nothing can be resting), not an overflow or crash."""
+    eng = BatchEngine(_cfg32(), n_slots=2, max_t=8)
+    assert eng.process([_del("x", BTC)]) == []
+    assert eng.stats.cancels_missed == 1
+
+
+def test_capacity_error_commits_nothing():
+    """ADVICE follow-through: an ADD batch that trips the span check raises
+    without widening the envelope, so retrying without the offending order
+    (and later recentering) succeeds."""
+    eng = BatchEngine(_cfg32(), n_slots=2, max_t=8)
+    eng.process([_add("a", BTC, side=Side.SALE)])
+    with pytest.raises(CapacityError):
+        eng.process([_add("bad", 100)])  # 1e13 span: unwindowable
+    # Lane not poisoned: drift-forced recenter still succeeds.
+    drift = BatchEngine.REBASE_LIMIT + 100_000
+    assert eng.process([_add("b", BTC + drift, side=Side.SALE)]) == []
+    events = eng.process([_del("a", BTC, side=Side.SALE)])
+    assert len(events) == 1
+    eng.verify_books()
+
+
+def test_gateway_rejects_lot_ceiling():
+    """ADVICE #2 (medium): the int32 lot ceiling is enforced at the gRPC
+    edge with code 3, like the volume<=0 check."""
+    from gome_tpu.api import order_pb2 as pb
+    from gome_tpu.config import Config, EngineConfig, GrpcConfig
+    from gome_tpu.service import EngineService
+
+    svc = EngineService(
+        Config(
+            grpc=GrpcConfig(port=0),
+            engine=EngineConfig(cap=16, n_slots=8, max_t=8, dtype="int32"),
+        )
+    )
+    # accuracy=8: volume 100.0 scales to 1e10 lots > LOT_MAX32 (~1.07e9).
+    resp = svc.gateway.DoOrder(
+        pb.OrderRequest(
+            uuid="u", oid="big", symbol="eth2usdt",
+            transaction=pb.BUY, price=1.0, volume=100.0,
+        ),
+        None,
+    )
+    assert resp.code == 3 and "ceiling" in resp.message
+    ok = svc.gateway.DoOrder(
+        pb.OrderRequest(
+            uuid="u", oid="ok", symbol="eth2usdt",
+            transaction=pb.BUY, price=1.0, volume=1.0,
+        ),
+        None,
+    )
+    assert ok.code == 0
+
+
+def test_consumer_poison_batch_quarantine():
+    """ADVICE #2 (medium): a deterministic per-batch failure stops blocking
+    after poison_threshold replays — the offending order is dead-lettered,
+    healthy neighbors still match, the offset advances, and the failed
+    attempts' consumed pre-pool marks are restored for the replay."""
+    from gome_tpu.bus import MemoryQueue, QueueBus, encode_order
+    from gome_tpu.engine.orchestrator import MatchEngine
+    from gome_tpu.engine.step import LOT_MAX32
+    from gome_tpu.service.consumer import OrderConsumer
+
+    engine = MatchEngine(config=_cfg32(), n_slots=8, max_t=8)
+    bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    consumer = OrderConsumer(
+        engine, bus, batch_n=16, batch_wait_s=0, poison_threshold=3
+    )
+
+    good1 = _add("g1", 100, side=Side.SALE, volume=5, symbol="eth2usdt")
+    poison = _add(
+        "poison", 100, side=Side.BUY, volume=LOT_MAX32 + 1, symbol="eth2usdt"
+    )
+    good2 = _add("g2", 100, side=Side.BUY, volume=5, symbol="eth2usdt")
+    for o in (good1, poison, good2):
+        engine.mark(o)
+        bus.order_queue.publish(encode_order(o))
+
+    # Two failed replays (policy not yet tripped), third triggers quarantine.
+    assert consumer.step_with_policy() == 0
+    assert bus.order_queue.committed() == 0
+    assert consumer.step_with_policy() == 0
+    n = consumer.step_with_policy()
+    assert n == 2  # good1 + good2 processed individually
+    assert bus.order_queue.committed() == 3  # stream advanced past poison
+    # good2 crossed good1: exactly one fill event published.
+    msgs = bus.match_queue.read_from(0, 10)
+    assert len(msgs) == 1
+    # Subsequent batches are healthy again.
+    ok = _add("g3", 100, side=Side.BUY, volume=1, symbol="eth2usdt")
+    engine.mark(ok)
+    bus.order_queue.publish(encode_order(ok))
+    assert consumer.step_with_policy() == 1
+
+
+def test_failed_batch_restores_prepool_marks():
+    """A batch that raises must put back the pre-pool keys it consumed so
+    the at-least-once replay does not drop its ADDs as unmarked."""
+    from gome_tpu.engine.orchestrator import MatchEngine
+
+    engine = MatchEngine(config=_cfg32(), n_slots=8, max_t=8)
+    good = _add("g", BTC, side=Side.SALE, volume=5)
+    bad = _add("bad", 100, volume=5)  # forces CapacityError with BTC
+    engine.mark(good)
+    engine.mark(bad)
+    with pytest.raises(CapacityError):
+        engine.process([good, bad])
+    # Replay without the poison order: the ADD must still be marked.
+    assert engine.process([good]) == []
+    assert engine.stats.dropped_no_prepool == 0
+    assert len(engine.process([_del("g", BTC, side=Side.SALE)])) == 1
+
+
+def test_failed_multigrid_batch_rolls_back_first_grid():
+    """A batch split over several grids (max_t overflow on one lane) that
+    raises on a later grid must roll the device books back past the already
+    committed earlier grids — otherwise the at-least-once replay
+    double-applies grid 1's orders."""
+    config = BookConfig(cap=2, max_fills=8, dtype=jnp.int32)
+    eng = BatchEngine(config, n_slots=2, max_t=2, max_cap=2)
+    # Grid 1: two resting SALEs fill lane 0's time axis AND the cap-2 book.
+    # Grid 2: the third rest overflows, cap escalation needs 4 > max_cap=2,
+    # CapacityError — AFTER grid 1 already committed device books.
+    batch = [
+        _add("a", BTC, side=Side.SALE, volume=5),
+        _add("b", BTC + 1, side=Side.SALE, volume=5),
+        _add("c", BTC + 2, side=Side.SALE, volume=5),
+    ]
+    with pytest.raises(CapacityError):
+        eng.process(batch)
+    # Books rolled back: nothing rests.
+    assert int(np.asarray(eng.books.count).sum()) == 0
+    # Replay without the poison order applies each ADD exactly once.
+    assert eng.process(batch[:2]) == []
+    events = eng.process([_add("t", BTC + 1, side=Side.BUY, volume=10)])
+    assert [e.match_volume for e in events] == [5, 5]
+    eng.verify_books()
+
+
+def test_x64_flip_refused_after_pallas_import():
+    """ADVICE #4 (low): ensure_dtype_usable must not flip jax_enable_x64
+    once the Pallas kernel module is loaded (mid-process flips can corrupt
+    trace caches). With x64 already on (this suite's conftest), the check
+    is a no-op; the refusal path is covered by a subprocess check in
+    scripts/fuzz.py's docstring contract."""
+    import sys
+
+    import gome_tpu.ops.pallas_match  # noqa: F401  (ensure loaded)
+    from gome_tpu.engine.book import ensure_dtype_usable
+
+    assert "gome_tpu.ops.pallas_match" in sys.modules
+    ensure_dtype_usable(jnp.int64)  # x64 already on: fine
