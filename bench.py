@@ -250,7 +250,132 @@ def pack_dense_rounds(grids, t_dense, s_total):
     return rounds
 
 
+def service_main():
+    """End-to-end SERVICE bench: binary ORDER frames through the real
+    consumer (frame decode -> pre-pool admission -> vectorized pack ->
+    device matching -> device-side event compaction -> one overlapped
+    fetch -> columnar decode -> EVENT-frame publish -> offset commit).
+
+    Prints ONE JSON line with the measured gateway->matchOrder number.
+    On this dev environment the device link runs at single-digit MB/s
+    (measured; a production TPU host attaches at PCIe speeds), so the
+    stderr breakdown also reports the pipeline rate excluding the time
+    blocked on that fetch — the number the same pipeline sustains when the
+    link is not the bottleneck."""
+    check = "--check" in sys.argv
+    import jax
+
+    if check:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from gome_tpu.bus import MemoryQueue, QueueBus
+    from gome_tpu.bus.colwire import encode_order_frame
+    from gome_tpu.engine import BookConfig
+    from gome_tpu.engine import frames as engine_frames
+    from gome_tpu.engine.orchestrator import MatchEngine
+    from gome_tpu.service.consumer import OrderConsumer
+
+    N = int(os.environ.get("SVC_ORDERS", 8_192 if check else 1_048_576))
+    FRAME = int(os.environ.get("SVC_FRAME", 2_048 if check else 262_144))
+    S = int(os.environ.get("SVC_SYMBOLS", 64 if check else 10_240))
+    CAP = int(os.environ.get("SVC_CAP", 32 if check else 256))
+    engine = MatchEngine(
+        config=BookConfig(cap=CAP, max_fills=16, dtype=jnp.int32),
+        n_slots=S,
+        max_t=32,
+        kernel="pallas",
+    )
+    bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    consumer = OrderConsumer(
+        engine, bus, batch_n=1, batch_wait_s=0, match_wire="frame"
+    )
+
+    rng = np.random.default_rng(7)
+    symbols = [f"sym{i}" for i in range(S)]
+
+    def build_frame(n, oid0):
+        sym_idx = rng.integers(0, S, n).astype(np.uint32)
+        side = rng.integers(0, 2, n).astype(np.uint8)
+        price = rng.integers(99_500_000, 100_500_000, n).astype(np.int64)
+        volume = rng.integers(1, 101, n).astype(np.int64)
+        oids = np.char.add(
+            "o", np.arange(oid0, oid0 + n).astype("U12")
+        ).astype("S")
+        payload = encode_order_frame(
+            n, np.ones(n, np.uint8), side, np.zeros(n, np.uint8),
+            price, volume, symbols, sym_idx,
+            ["u"], np.zeros(n, np.uint32), oids,
+        )
+        return payload, sym_idx, oids
+
+    # Generate + gateway-mark everything off the clock (marking is the
+    # gateway's job, concurrent with the consumer in a real deployment).
+    pool = engine.pre_pool
+    payloads = []
+    oid0 = 1
+    # Two warmup frames: frame geometry (grid-2 packed counts, compaction
+    # pow2 classes) only stabilizes after the books reach steady state, and
+    # every distinct shape is a tens-of-seconds AOT compile on the tunnel —
+    # all of it must happen off the clock. Chunk by min(FRAME, N) so small
+    # SVC_ORDERS runs still produce distinct warmup + timed frames.
+    FRAME = min(FRAME, N)
+    N_WARM = 2
+    n_warm = N_WARM * FRAME
+    for start in range(0, n_warm + N, FRAME):
+        n = min(FRAME, n_warm + N - start)
+        payload, sym_idx, oids = build_frame(n, oid0)
+        oid0 += n
+        payloads.append(payload)
+        for k, o in zip(sym_idx.tolist(), oids.tolist()):
+            pool.add((symbols[k], "u", o.decode()))
+
+    for p in payloads[:N_WARM]:
+        bus.order_queue.publish(p)
+    consumer.drain()
+    engine_frames.FETCH_SECONDS = 0.0
+
+    ev_skip = bus.match_queue.end_offset()  # warmup frames' events
+    for p in payloads[N_WARM:]:
+        bus.order_queue.publish(p)
+    t0 = time.perf_counter()
+    n_done = consumer.drain()
+    elapsed = time.perf_counter() - t0
+    fetch_s = engine_frames.FETCH_SECONDS
+
+    from gome_tpu.bus.colwire import decode_event_frame
+
+    n_events = 0
+    ev_bytes = 0
+    for m in bus.match_queue.read_from(ev_skip, 1 << 30):
+        ev_bytes += len(m.body)
+        n_events += len(decode_event_frame(m.body))
+
+    throughput = n_done / elapsed
+    result = {
+        "metric": (
+            f"service throughput gateway->matchOrder, {S} symbols, "
+            f"{FRAME}-order frames, int32 pallas, device-side event "
+            "compaction"
+        ),
+        "value": round(throughput),
+        "unit": "orders/sec",
+        "vs_baseline": round(throughput / 1_000_000, 3),
+    }
+    print(json.dumps(result))
+    host_s = max(elapsed - fetch_s, 1e-9)
+    print(
+        f"# orders={n_done} events={n_events} elapsed={elapsed:.3f}s "
+        f"fetch_blocked={fetch_s:.3f}s (dev-tunnel link) | "
+        f"pipeline-ex-fetch {n_done / host_s / 1e6:.2f}M orders/sec | "
+        f"event-frame bytes/order={ev_bytes / max(n_done, 1):.1f}",
+        file=sys.stderr,
+    )
+
+
 def main():
+    if "--service" in sys.argv:
+        return service_main()
     check = "--check" in sys.argv
     DTYPE = os.environ.get("BENCH_DTYPE", "int32")  # int64 | int32
     import jax

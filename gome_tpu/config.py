@@ -68,6 +68,10 @@ class BusConfig:
     password: str = ""
     order_queue: str = "doOrder"  # rabbitmq.go: queue names
     match_queue: str = "matchOrder"
+    # matchOrder payload: "json" = one reference-shape document per event
+    # (rabbitmq.go parity); "frame" = one binary EVENT frame per batch
+    # (bus.colwire, the high-throughput internal transport).
+    match_wire: str = "json"
 
     _BACKENDS = ("memory", "file", "cfile", "amqp")
 
@@ -76,6 +80,10 @@ class BusConfig:
             raise ValueError(
                 f"bus.backend must be one of {self._BACKENDS}, "
                 f"got {self.backend!r}"
+            )
+        if self.match_wire not in ("json", "frame"):
+            raise ValueError(
+                f"bus.match_wire must be json|frame, got {self.match_wire!r}"
             )
 
 

@@ -180,6 +180,31 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def splice_outs(outs, overrides):
+    """Build the `outs_at(field, rows, ts)` accessor decode_grid_columnar
+    needs: reads StepOutput columns at packed (row, t) coordinates and
+    splices in per-row escalation re-runs (each with its own record budget
+    K', padded to align). Shared by the object packer and the frame path."""
+
+    def outs_at(field, rows, ts):
+        base = np.asarray(getattr(outs, field))[rows, ts]
+        for r, src in overrides.items():
+            m = rows == r
+            if not m.any():
+                continue
+            ov = np.asarray(getattr(src, field))[ts[m]]
+            if base.ndim > 1:
+                k_base, k_ov = base.shape[1], ov.shape[1]
+                if k_ov > k_base:
+                    base = np.pad(base, [(0, 0), (0, k_ov - k_base)])
+                elif k_ov < k_base:
+                    ov = np.pad(ov, [(0, 0), (0, k_base - k_ov)])
+            base[m] = ov
+        return base
+
+    return outs_at
+
+
 class CapacityError(RuntimeError):
     """A configured growth ceiling (max_slots / max_cap) was hit. The book
     state is unchanged for the op that tripped it; callers may shed load or
@@ -375,6 +400,31 @@ class BatchEngine:
             ):
                 drop[i] = True
         return drop
+
+    def _grid_geometry(self, live: np.ndarray):
+        """Grid geometry decision, shared by the object packer and the
+        frame path (engine.frames): when the batch touches few of the
+        provisioned lanes, pack a compact grid over just the live lanes
+        (row -> lane indirection, executed by dense_batch_step); rows
+        bucket to powers of two (min 8 — the Pallas kernel's sublane
+        floor; sentinel padding rows are free) to bound compile shapes.
+        The full [n_slots, *] grid remains for wide batches and under a
+        mesh (a cross-shard gather would need collectives).
+
+        Returns (use_dense, n_rows, lane_ids); lane_ids is None for full
+        grids."""
+        use_dense = (
+            self.dense
+            and self.mesh is None
+            and len(live) > 0
+            and max(8, _next_pow2(len(live))) < self.n_slots
+        )
+        if not use_dense:
+            return False, self.n_slots, None
+        n_rows = max(8, _next_pow2(len(live)))
+        lane_ids = np.full(n_rows, self.n_slots, np.int64)
+        lane_ids[: len(live)] = live
+        return True, n_rows, lane_ids
 
     def _admit_lane_range(self, lane: int, l: int, h: int) -> None:
         """Admit the ADD-limit price range [l, h] into `lane`'s grow-only
@@ -631,38 +681,20 @@ class BatchEngine:
             t[i] = c
             level[lane] = c + 1
 
-        # Grid geometry: when the batch touches few of the provisioned
-        # lanes, pack a compact grid over just the live lanes (row ->
-        # lane indirection, executed by dense_batch_step); row and time
-        # axes bucket to powers of two to bound compile shapes. The full
-        # [n_slots, max_t] grid remains for wide batches and under a mesh
-        # (a cross-shard gather would need collectives).
         live = (
             np.unique(lanes[~drop]) if bool((~drop).any())
             else np.zeros(0, np.int64)
         )
-        use_dense = (
-            self.dense
-            and self.mesh is None
-            and len(live) > 0
-            and max(8, _next_pow2(len(live))) < self.n_slots
-        )
+        use_dense, n_rows, lane_ids = self._grid_geometry(live)
         if use_dense:
             row = np.searchsorted(live, lanes)
-            # Min 8 rows: the Pallas kernel's sublane-alignment floor, and
-            # padding rows cost nothing (sentinel gather/drop).
-            n_rows = max(8, _next_pow2(len(live)))
             t_grid = min(
                 _next_pow2(max(level.values())),
                 max(self.dense_t_max, self.max_t),
             )
-            lane_ids = np.full(n_rows, self.n_slots, np.int64)
-            lane_ids[: len(live)] = live
         else:
             row = lanes
-            n_rows = self.n_slots
             t_grid = self.max_t
-            lane_ids = None
         packed = (t >= 0) & (t < t_grid)
 
         oids, uids = self.oids, self.uids
@@ -742,27 +774,9 @@ class BatchEngine:
             (int(r), int(tt)): None for r, tt in zip(meta["row"], meta["t"])
         }
         outs, lane_overrides = self._run_exact(ops, contexts, lane_ids)
-
-        def outs_at(field, rows, ts):
-            base = np.asarray(getattr(outs, field))[rows, ts]
-            for r, src in lane_overrides.items():
-                m = rows == r
-                if not m.any():
-                    continue
-                ov = np.asarray(getattr(src, field))[ts[m]]
-                if base.ndim > 1:
-                    # Each escalated lane carries its own record budget K';
-                    # pad whichever side is narrower (two escalated lanes in
-                    # one grid can have different K').
-                    k_base, k_ov = base.shape[1], ov.shape[1]
-                    if k_ov > k_base:
-                        base = np.pad(base, [(0, 0), (0, k_ov - k_base)])
-                    elif k_ov < k_base:
-                        ov = np.pad(ov, [(0, 0), (0, k_base - k_ov)])
-                base[m] = ov
-            return base
-
-        batches.append(decode_grid_columnar(meta, outs_at))
+        batches.append(
+            decode_grid_columnar(meta, splice_outs(outs, lane_overrides))
+        )
         return leftover
 
     def _one_grid(self, pending, decoded):

@@ -150,6 +150,66 @@ class MatchEngine:
     def books(self):
         return self.batch.books
 
+    def process_frame(self, cols: dict, fast: bool = True):
+        """Columnar-frame ingestion (bus.colwire ORDER frames): admission
+        semantics identical to process() — unmarked ADDs drop, DELs clear
+        their marks — applied by filtering the columns, then the
+        zero-per-order-Python frame path (engine.frames) runs the batch.
+        Returns an EventBatch. fast=True uses the pipelined device-side
+        event-compaction path (one fetch per frame; transparently falls
+        back to the exact escalating path when a device budget trips)."""
+        import numpy as np
+
+        from . import frames
+
+        n = int(cols["n"])
+        action = cols["action"].tolist()
+        syms, uuids = cols["symbols"], cols["uuids"]
+        sidx, uidx = cols["symbol_idx"].tolist(), cols["uuid_idx"].tolist()
+        oid_list = [o.decode() for o in cols["oids"].tolist()]
+        keep = np.ones(n, bool)
+        consumed: set[tuple[str, str, str]] = set()
+        pool = self.pre_pool
+        ADD, DEL = int(Action.ADD), int(Action.DEL)
+        # Key construction at C speed: list-comp indexing + zip tuples;
+        # symbol/uuid string objects are shared (hashes cached), only the
+        # oid hash is fresh per order.
+        keys = list(
+            zip((syms[k] for k in sidx), (uuids[k] for k in uidx), oid_list)
+        )
+        for i, (a, key) in enumerate(zip(action, keys)):
+            if a == ADD:
+                if key not in pool:
+                    keep[i] = False
+                    self.stats.dropped_no_prepool += 1
+                    continue
+                pool.discard(key)
+                consumed.add(key)
+            elif a == DEL:
+                if key in pool:
+                    pool.discard(key)
+                    consumed.add(key)
+            else:  # NOP padding never reaches the device
+                keep[i] = False
+        if not keep.all():
+            cols = dict(
+                cols,
+                n=int(keep.sum()),
+                **{
+                    k: np.ascontiguousarray(cols[k][keep])
+                    for k in (
+                        "action", "side", "kind", "price", "volume",
+                        "symbol_idx", "uuid_idx", "oids",
+                    )
+                },
+            )
+        run = frames.apply_frame_fast if fast else frames.process_frame
+        try:
+            return run(self.batch, cols)
+        except Exception:
+            self.pre_pool |= consumed
+            raise
+
     @staticmethod
     def _prekey(order: Order) -> tuple[str, str, str]:
         """S:comparison field = S:U:O (ordernode.go:89-92)."""

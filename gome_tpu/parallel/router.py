@@ -96,6 +96,17 @@ class ShardedEngine:
         merged.sort(key=lambda kv: kv[0])
         return [ev for _, evs in merged for ev in evs]
 
+    def process_frame(self, cols: dict):
+        """ORDER-frame ingestion on the in-process sharded facade: decodes
+        to Orders and runs the exact object path — admission semantics
+        included (per-shard columnar splitting with per-shard interner
+        tables is not worth the complexity here; sharded DEPLOYMENTS route
+        frames to per-shard doOrder queues upstream, so each shard's
+        consumer gets whole frames and the native frame pipeline)."""
+        from ..engine.frames import orders_from_frame
+
+        return _ResultsBatch(self.process(orders_from_frame(cols)))
+
     def process_with_arrival_order(
         self, orders: list[Order]
     ) -> list[MatchResult]:
@@ -106,6 +117,25 @@ class ShardedEngine:
     @property
     def stats(self):
         return [s.stats for s in self.shards]
+
+
+class _ResultsBatch:
+    """list[MatchResult] with the minimal EventBatch surface the consumer's
+    publish path uses (len, to_results, to_json_lines)."""
+
+    def __init__(self, results):
+        self._results = results
+
+    def __len__(self):
+        return len(self._results)
+
+    def to_results(self):
+        return list(self._results)
+
+    def to_json_lines(self):
+        from ..bus import encode_match_result
+
+        return [encode_match_result(r) for r in self._results]
 
 
 def multihost_mesh(n_local: int | None = None):

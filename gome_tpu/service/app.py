@@ -48,6 +48,7 @@ class EngineService:
             self.bus,
             batch_n=e.max_t * max(1, e.n_slots // 8),
             on_batch=on_batch,
+            match_wire=self.config.bus.match_wire,
         )
         from ..engine.step import LOT_MAX32
 

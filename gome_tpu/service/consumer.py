@@ -55,9 +55,17 @@ class OrderConsumer:
         batch_wait_s: float = 0.002,
         on_batch=None,
         poison_threshold: int = 3,
+        match_wire: str = "json",
     ):
+        """match_wire: "json" publishes one reference-shape JSON document
+        per event (rabbitmq.go wire parity); "frame" publishes one binary
+        EVENT frame per batch (bus.colwire) — the high-throughput internal
+        transport (the feed decodes both)."""
+        if match_wire not in ("json", "frame"):
+            raise ValueError(f"match_wire must be json|frame, got {match_wire}")
         self.engine = engine
         self.bus = bus
+        self.match_wire = match_wire
         self.batch_n = batch_n
         self.batch_wait_s = batch_wait_s
         self.on_batch = on_batch  # callback(n_orders, n_events): persist hook
@@ -73,37 +81,72 @@ class OrderConsumer:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
+    def _publish(self, batch) -> None:
+        # Frame publishing needs real EventBatch columns; the sharded
+        # facade's compatibility wrapper (router._ResultsBatch) publishes
+        # reference JSON instead.
+        if self.match_wire == "frame" and hasattr(batch, "columns"):
+            from ..bus.colwire import encode_event_frame
+
+            if len(batch):
+                self.bus.match_queue.publish(encode_event_frame(batch))
+        else:
+            # one write+fsync for the whole batch on the native backend
+            self.bus.match_queue.publish_batch(batch.to_json_lines())
+
     def run_once(self) -> int:
         """Drain one micro-batch; returns the number of orders processed."""
         msgs = self.bus.order_queue.poll_batch(self.batch_n, self.batch_wait_s)
         if not msgs:
             return 0
+        from ..bus.colwire import decode_order_frame, is_frame
+
+        n_orders = n_events = 0
         with _batch_latency.time() as timer:
-            with annotate("decode_orders"):
-                # one native call for the whole batch (json fallback inside)
-                orders = decode_orders_batch([m.body for m in msgs])
-            with annotate("engine_process"):
-                # Columnar path end to end: events stay as numpy columns
-                # from decode through wire serialization; no per-event
-                # Python objects on the hot path (engine/events.py).
-                batch = self.engine.process_columnar(orders)
-            with annotate("publish_events"):
-                # one write+fsync for the whole batch on the native backend
-                self.bus.match_queue.publish_batch(batch.to_json_lines())
-            n_events = len(batch)
+            # Split the poll into runs: contiguous JSON messages decode as
+            # one batch (native codec); a binary ORDER frame (colwire) IS
+            # a batch and takes the zero-per-order-Python frame path. Both
+            # producers can share the queue (migration story).
+            i = 0
+            while i < len(msgs):
+                if is_frame(msgs[i].body):
+                    with annotate("engine_process_frame"):
+                        cols = decode_order_frame(msgs[i].body)
+                        batch = self.engine.process_frame(cols)
+                        count = int(cols["n"])
+                    i += 1
+                else:
+                    j = i
+                    while j < len(msgs) and not is_frame(msgs[j].body):
+                        j += 1
+                    with annotate("decode_orders"):
+                        orders = decode_orders_batch(
+                            [m.body for m in msgs[i:j]]
+                        )
+                    with annotate("engine_process"):
+                        # Columnar path end to end: events stay as numpy
+                        # columns from decode through wire serialization;
+                        # no per-event Python objects on the hot path.
+                        batch = self.engine.process_columnar(orders)
+                    count = len(orders)
+                    i = j
+                with annotate("publish_events"):
+                    self._publish(batch)
+                n_orders += count
+                n_events += len(batch)
             # Commit only after results are published: a crash between
             # processing and commit replays the batch (at-least-once;
             # recovery dedup lives in gome_tpu.persist's replay logic).
             self.bus.order_queue.commit(msgs[-1].offset + 1)
-        _orders_total.inc(len(orders))
+        _orders_total.inc(n_orders)
         _events_total.inc(n_events)
-        _batch_size.observe(len(orders))
+        _batch_size.observe(n_orders)
         if timer.elapsed > 0:
-            inst = len(orders) / timer.elapsed
+            inst = n_orders / timer.elapsed
             _throughput.set(0.8 * _throughput.value() + 0.2 * inst)
         if self.on_batch is not None:
-            self.on_batch(len(orders), n_events)
-        return len(orders)
+            self.on_batch(n_orders, n_events)
+        return n_orders
 
     def drain(self) -> int:
         """Process until the order queue is empty (tests, recovery replay)."""
@@ -163,17 +206,32 @@ class OrderConsumer:
         events are ever dead-lettered because the match queue hiccuped."""
         msgs = self.bus.order_queue.poll_batch(self.batch_n, 0)
         processed = 0
+        from ..bus.colwire import decode_order_frame, is_frame
+
         for m in msgs:
             orders = []
+            decode_for_unmark = lambda: []  # replaced once decode succeeds
             try:
-                orders = decode_orders_batch([m.body])
+                if is_frame(m.body):
+                    cols = decode_order_frame(m.body)
+                    run = lambda: self.engine.process_frame(cols)
+
+                    def decode_for_unmark(_cols=cols):
+                        from ..engine.frames import orders_from_frame
+
+                        return orders_from_frame(_cols)
+
+                else:
+                    orders = decode_orders_batch([m.body])
+                    run = lambda: self.engine.process_columnar(orders)
+                    decode_for_unmark = lambda: orders
                 try:
-                    batch = self.engine.process_columnar(orders)
+                    batch = run()
                 except Exception:
                     # Confirm determinism with one retry before discarding:
                     # a transient fault (device hiccup) must not cost a
                     # healthy order. The failed attempt rolled back.
-                    batch = self.engine.process_columnar(orders)
+                    batch = run()
             except Exception:
                 _poisoned.inc(1)
                 log.exception(
@@ -183,15 +241,19 @@ class OrderConsumer:
                 # The failed engine call restored its consumed pre-pool
                 # marks; a dead-lettered ADD will never be replayed, so its
                 # mark must not linger (it would persist into snapshots as
-                # a live queued ADD).
+                # a live queued ADD). Frames decode here too — only for
+                # this rare dead-letter path.
                 unmark = getattr(self.engine, "unmark", None)
                 if unmark is not None:
-                    for o in orders:
-                        unmark(o)
+                    try:
+                        for o in decode_for_unmark():
+                            unmark(o)
+                    except Exception:
+                        log.exception("could not unmark dead-lettered orders")
                 self.bus.order_queue.commit(m.offset + 1)
                 continue
             try:
-                self.bus.match_queue.publish_batch(batch.to_json_lines())
+                self._publish(batch)
             except Exception:
                 log.exception(
                     "publish failed during quarantine at offset %d; "

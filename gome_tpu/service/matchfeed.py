@@ -54,23 +54,31 @@ class MatchFeed:
         msgs = self.bus.match_queue.poll_batch(256, 0.002)
         if not msgs:
             return 0
+        from ..bus.colwire import decode_event_frame, is_frame
+
         with self._lock:
             subs = list(self._subs)
         for m in msgs:
-            mr = decode_match_result(m.body)
-            self.events_seen += 1
-            if self.log_events:
-                # rabbitmq.go:170's util.Info.Printf of the result
-                log.info(
-                    "match %s: taker=%s maker=%s qty=%d",
-                    "CANCEL" if mr.is_cancel else "FILL",
-                    mr.node.oid,
-                    mr.match_node.oid,
-                    mr.match_volume,
-                )
-            ev = match_result_to_pb(mr)
-            for q in subs:
-                q.put(ev)
+            if is_frame(m.body):
+                # Binary EVENT frame (bus.colwire): one message = a whole
+                # batch of MatchResults.
+                results = decode_event_frame(m.body).to_results()
+            else:
+                results = [decode_match_result(m.body)]
+            for mr in results:
+                self.events_seen += 1
+                if self.log_events:
+                    # rabbitmq.go:170's util.Info.Printf of the result
+                    log.info(
+                        "match %s: taker=%s maker=%s qty=%d",
+                        "CANCEL" if mr.is_cancel else "FILL",
+                        mr.node.oid,
+                        mr.match_node.oid,
+                        mr.match_volume,
+                    )
+                ev = match_result_to_pb(mr)
+                for q in subs:
+                    q.put(ev)
         self.bus.match_queue.commit(msgs[-1].offset + 1)
         return len(msgs)
 

@@ -1,0 +1,289 @@
+"""Frame path (engine.frames + bus.colwire): wire codec round-trips and
+differential parity — the vectorized frame path must produce the identical
+EventBatch the object path produces for the same orders."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gome_tpu.bus import colwire
+from gome_tpu.engine import BatchEngine, BookConfig
+from gome_tpu.engine.frames import process_frame
+from gome_tpu.oracle import OracleEngine
+from gome_tpu.types import Action, Order, OrderType, Side
+from gome_tpu.utils.streams import multi_symbol_stream
+
+
+def orders_to_frame(orders):
+    """Encode a list of Orders as one ORDER frame (what a batching gateway
+    or the columnar load client produces)."""
+    n = len(orders)
+    syms, uuids = [], []
+    sym_ix, uuid_ix = {}, {}
+    sym_idx = np.empty(n, np.uint32)
+    uuid_idx = np.empty(n, np.uint32)
+    cols = {
+        "action": np.empty(n, np.uint8),
+        "side": np.empty(n, np.uint8),
+        "kind": np.empty(n, np.uint8),
+        "price": np.empty(n, np.int64),
+        "volume": np.empty(n, np.int64),
+    }
+    oids = []
+    for i, o in enumerate(orders):
+        cols["action"][i] = int(o.action)
+        cols["side"][i] = int(o.side)
+        cols["kind"][i] = int(o.order_type)
+        cols["price"][i] = o.price
+        cols["volume"][i] = o.volume
+        if o.symbol not in sym_ix:
+            sym_ix[o.symbol] = len(syms)
+            syms.append(o.symbol)
+        sym_idx[i] = sym_ix[o.symbol]
+        if o.uuid not in uuid_ix:
+            uuid_ix[o.uuid] = len(uuids)
+            uuids.append(o.uuid)
+        uuid_idx[i] = uuid_ix[o.uuid]
+        oids.append(o.oid)
+    return colwire.encode_order_frame(
+        n, cols["action"], cols["side"], cols["kind"], cols["price"],
+        cols["volume"], syms, sym_idx, uuids, uuid_idx, oids,
+    )
+
+
+def run_frames(eng, orders, chunk, fast=False):
+    from gome_tpu.engine.frames import apply_frame_fast
+
+    out = []
+    for i in range(0, len(orders), chunk):
+        payload = orders_to_frame(orders[i : i + chunk])
+        assert colwire.is_frame(payload)
+        cols = colwire.decode_order_frame(payload)
+        run = (
+            (lambda c: apply_frame_fast(eng, c))
+            if fast
+            else (lambda c: process_frame(eng, c))
+        )
+        out.extend(run(cols).to_results())
+    return out
+
+
+def run_objects(eng, orders, chunk):
+    out = []
+    for i in range(0, len(orders), chunk):
+        out.extend(eng.process_columnar(orders[i : i + chunk]).to_results())
+    return out
+
+
+def _oracle(orders):
+    oracle = OracleEngine()
+    out = []
+    for o in orders:
+        out.extend(oracle.process(o))
+    return out
+
+
+@pytest.mark.parametrize(
+    "n_slots,chunk,fast",
+    [(64, 97, False), (8, 50, False), (64, 97, True), (8, 50, True)],
+)
+def test_frame_path_matches_object_path_and_oracle(n_slots, chunk, fast):
+    orders = multi_symbol_stream(n=400, n_symbols=6, seed=21, cancel_prob=0.2)
+    a = BatchEngine(BookConfig(cap=32, max_fills=8), n_slots=n_slots, max_t=8)
+    b = BatchEngine(BookConfig(cap=32, max_fills=8), n_slots=n_slots, max_t=8)
+    got_f = run_frames(a, orders, chunk, fast=fast)
+    got_o = run_objects(b, orders, chunk)
+    assert got_f == got_o == _oracle(orders)
+    a.verify_books()
+    ba, bb = a.lane_books(), b.lane_books()
+    for name in ("price", "lots", "seq", "count", "next_seq"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ba, name)), np.asarray(getattr(bb, name))
+        )
+    # oid/uid leaves hold interner ids, and the frame path interns in
+    # sorted-unique order (np.unique) vs the object path's first-occurrence
+    # order — compare through the tables.
+    for leaf, ta, tb in (
+        ("oid", a.oids.table, b.oids.table),
+        ("uid", a.uids.table, b.uids.table),
+    ):
+        xa = np.asarray(getattr(ba, leaf), np.int64)
+        xb = np.asarray(getattr(bb, leaf), np.int64)
+        sa = np.array(ta, dtype=object)[xa]
+        sb = np.array(tb, dtype=object)[xb]
+        active = np.asarray(ba.lots) > 0
+        assert (sa[active] == sb[active]).all(), leaf
+
+
+def test_frame_path_int32_rebasing_and_dropped_dels():
+    BTC = 10_000_000_000_000
+    rng = np.random.default_rng(5)
+    orders = []
+    for i in range(250):
+        is_del = i > 20 and rng.random() < 0.2
+        orders.append(
+            Order(
+                uuid=f"u{int(rng.integers(0, 3))}",
+                oid=str(int(rng.integers(1, i)) if is_del else i),
+                symbol=f"sym{int(rng.integers(0, 4))}",
+                side=Side(int(rng.integers(0, 2))),
+                price=BTC + int(rng.integers(-2000, 2000)),
+                volume=int(rng.integers(1, 30)),
+                action=Action.DEL if is_del else Action.ADD,
+            )
+        )
+    # One in-contract wrong-price cancel (the poison scenario).
+    orders.append(
+        Order(uuid="u0", oid="0", symbol="sym0", side=Side.BUY,
+              price=50_000_000, volume=0, action=Action.DEL)
+    )
+    eng = BatchEngine(
+        BookConfig(cap=64, max_fills=8, dtype=jnp.int32), n_slots=64, max_t=8
+    )
+    got = run_frames(eng, orders, 80)
+    assert got == _oracle(orders)
+    assert eng.stats.cancels_missed >= 1
+    eng.verify_books()
+
+
+def test_fast_path_falls_back_on_escalation():
+    """apply_frame_fast must detect tripped budgets (book overflow, record
+    truncation) via the compaction totals and re-run exactly."""
+    rng = np.random.default_rng(31)
+    orders = [
+        Order(uuid="u", oid=str(i), symbol="s", side=Side.SALE,
+              price=100 + i, volume=1)
+        for i in range(40)  # overflows cap=8
+    ]
+    orders.append(
+        Order(uuid="u", oid="sweep", symbol="s", side=Side.BUY, price=300,
+              volume=1000)  # 40 fills > max_fills=4
+    )
+    eng = BatchEngine(BookConfig(cap=8, max_fills=4), n_slots=16, max_t=4)
+    got = run_frames(eng, orders, len(orders), fast=True)
+    assert got == _oracle(orders)
+    assert eng.stats.cap_escalations >= 1
+    eng.verify_books()
+
+
+def test_frame_path_deep_single_symbol_and_escalations():
+    rng = np.random.default_rng(9)
+    orders = [
+        Order(uuid="u", oid=str(i), symbol="hot",
+              side=Side(int(rng.integers(0, 2))),
+              price=100 + int(rng.integers(-3, 4)),
+              volume=int(rng.integers(1, 8)))
+        for i in range(500)
+    ]
+    # sweep order crossing far more than max_fills resting orders
+    orders.append(
+        Order(uuid="u", oid="sweep", symbol="hot", side=Side.BUY,
+              price=200, volume=100000)
+    )
+    eng = BatchEngine(BookConfig(cap=16, max_fills=4), n_slots=64, max_t=4)
+    got = run_frames(eng, orders, len(orders))
+    assert got == _oracle(orders)
+    assert eng.stats.cap_escalations >= 1
+    eng.verify_books()
+
+
+def test_frame_market_orders():
+    orders = [
+        Order(uuid="m", oid="r1", symbol="s", side=Side.SALE, price=105,
+              volume=10),
+        Order(uuid="m", oid="r2", symbol="s", side=Side.SALE, price=110,
+              volume=10),
+        Order(uuid="t", oid="mkt", symbol="s", side=Side.BUY, price=0,
+              volume=15, order_type=OrderType.MARKET),
+    ]
+    eng = BatchEngine(BookConfig(cap=16, max_fills=8), n_slots=16, max_t=8)
+    got = run_frames(eng, orders, 3)
+    assert got == _oracle(orders)
+    assert [e.match_volume for e in got] == [10, 5]
+
+
+def test_event_frame_round_trip():
+    """EventBatch -> EVENT frame -> EventBatch: identical events and
+    identical reference-JSON serialization."""
+    orders = multi_symbol_stream(n=200, n_symbols=4, seed=2, cancel_prob=0.2)
+    eng = BatchEngine(BookConfig(cap=32, max_fills=8), n_slots=32, max_t=8)
+    batch = eng.process_columnar(orders)
+    payload = colwire.encode_event_frame(batch)
+    assert colwire.is_frame(payload)
+    back = colwire.decode_event_frame(payload)
+    assert back.to_results() == batch.to_results()
+    assert back.to_json_lines() == batch.to_json_lines()
+
+
+def test_service_frame_path_end_to_end():
+    """ORDER frames through the real consumer (admission incl. the
+    cancel-before-consume race) with EVENT-frame publishing, decoded by
+    the match feed — parity with the oracle."""
+    from gome_tpu.bus import MemoryQueue, QueueBus
+    from gome_tpu.engine.orchestrator import MatchEngine
+    from gome_tpu.service.consumer import OrderConsumer
+    from gome_tpu.service.matchfeed import MatchFeed
+
+    orders = multi_symbol_stream(n=300, n_symbols=5, seed=13, cancel_prob=0.2)
+    engine = MatchEngine(
+        config=BookConfig(cap=32, max_fills=8), n_slots=64, max_t=8
+    )
+    bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    consumer = OrderConsumer(
+        engine, bus, batch_n=64, batch_wait_s=0, match_wire="frame"
+    )
+    feed = MatchFeed(bus, log_events=False)
+    for o in orders:
+        engine.mark(o)
+    for i in range(0, len(orders), 70):
+        bus.order_queue.publish(orders_to_frame(orders[i : i + 70]))
+    n = consumer.drain()
+    assert n == len(orders)
+    # decode the EVENT frames back to MatchResults
+    got = []
+    from gome_tpu.bus.colwire import decode_event_frame
+
+    for m in bus.match_queue.read_from(0, 10000):
+        got.extend(decode_event_frame(m.body).to_results())
+    assert got == _oracle(orders)
+    feed.drain()
+    assert feed.events_seen == len(got)
+
+
+def test_frame_admission_cancel_race():
+    """An ADD whose mark was cleared by an earlier cancel must drop at
+    frame admission (engine.go:58-62 semantics)."""
+    from gome_tpu.engine.orchestrator import MatchEngine
+
+    engine = MatchEngine(
+        config=BookConfig(cap=16, max_fills=4), n_slots=16, max_t=8
+    )
+    add = Order(uuid="u", oid="1", symbol="s", side=Side.BUY, price=100,
+                volume=5)
+    kill = Order(uuid="u", oid="1", symbol="s", side=Side.BUY, price=100,
+                 volume=0, action=Action.DEL)
+    engine.mark(add)
+    # cancel consumed first clears the mark; the queued ADD then dies
+    from gome_tpu.bus import colwire
+
+    batch = engine.process_frame(
+        colwire.decode_order_frame(orders_to_frame([kill, add]))
+    )
+    assert len(batch) == 0
+    assert engine.stats.dropped_no_prepool == 1
+    assert int(np.asarray(engine.books.count).sum()) == 0
+
+
+def test_order_frame_codec_edge_cases():
+    # empty batch
+    payload = orders_to_frame([])
+    cols = colwire.decode_order_frame(payload)
+    assert cols["n"] == 0
+    # single order, long ids
+    o = Order(uuid="user-" + "x" * 40, oid="order-" + "y" * 60,
+              symbol="somesym2usdt", side=Side.BUY, price=123, volume=7)
+    cols = colwire.decode_order_frame(orders_to_frame([o]))
+    assert cols["symbols"] == ["somesym2usdt"]
+    assert cols["uuids"][cols["uuid_idx"][0]] == o.uuid
+    assert cols["oids"][0].decode() == o.oid
+    assert cols["price"][0] == 123 and cols["volume"][0] == 7
