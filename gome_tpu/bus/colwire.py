@@ -89,12 +89,18 @@ def _read_dict_column(buf: memoryview, off: int, n: int):
     return values, idx, off
 
 
-def _pack_padded_column(strs, n: int) -> bytes:
-    """strs: list[str] (or np 'S' array). Pads to the batch max width."""
+def _pack_padded_column(strs) -> bytes:
+    """strs: list[str] (or np 'S' array). Pads to the batch max width.
+    str inputs are encoded to UTF-8 bytes FIRST — np.array(dtype='S') on
+    str objects is ASCII-only and would crash on in-contract non-ASCII
+    ids."""
     if isinstance(strs, np.ndarray) and strs.dtype.kind == "S":
         arr = np.ascontiguousarray(strs)
     else:
-        arr = np.array([s.encode() for s in strs], dtype="S")
+        arr = np.array(
+            [s if isinstance(s, bytes) else s.encode() for s in strs],
+            dtype="S",
+        )
         if arr.dtype.itemsize == 0:  # all-empty edge
             arr = arr.astype("S1")
     return struct.pack("<H", arr.dtype.itemsize) + arr.tobytes()
@@ -130,7 +136,7 @@ def encode_order_frame(
         parts.append(np.ascontiguousarray(col, dt).tobytes())
     parts.append(_pack_dict_column(symbols, symbol_idx))
     parts.append(_pack_dict_column(uuids, uuid_idx))
-    parts.append(_pack_padded_column(oids, n))
+    parts.append(_pack_padded_column(oids))
     return b"".join(parts)
 
 
@@ -165,16 +171,12 @@ def _pack_id_table(table, used: np.ndarray) -> bytes:
 
     count = len(used)
     if count == 0:
-        values = np.zeros(0, "S1")
+        gathered = []
     elif count == 1:
-        values = np.array([table[int(used[0])]], dtype="S")
+        gathered = [table[int(used[0])]]
     else:
-        values = np.array(
-            operator.itemgetter(*used.tolist())(table), dtype="S"
-        )
-    if values.dtype.itemsize == 0:
-        values = values.astype("S1")
-    return struct.pack("<I", count) + _pack_padded_column(values, count)
+        gathered = list(operator.itemgetter(*used.tolist())(table))
+    return struct.pack("<I", count) + _pack_padded_column(gathered)
 
 
 def _read_id_table(buf: memoryview, off: int):

@@ -283,10 +283,11 @@ class BatchEngine:
         mesh: an optional 1-D jax.sharding.Mesh (gome_tpu.parallel.make_mesh)
         partitioning the symbol-lane axis across chips. Matching needs zero
         collectives (symbols share nothing, SURVEY §2.1), so the sharded
-        step is the same scan graph with shardings pinned; lane counts stay
-        multiples of the mesh size (growth rounds up). The compiled Pallas
-        kernel is single-chip — with a mesh the scan path runs (per-chip
-        Pallas under shard_map is future work)."""
+        step is the same graph with shardings pinned; lane counts stay
+        multiples of the mesh size (growth rounds up). kernel="pallas"
+        under a mesh runs the compiled VMEM kernel per chip inside a
+        shard_map (gome_tpu.parallel.mesh.sharded_batch_step), preserving
+        the kernel's throughput win at multi-chip scale."""
         if kernel not in KERNELS:
             raise ValueError(f"kernel must be one of {KERNELS}, got {kernel!r}")
         if config.cap > max_cap:
@@ -915,7 +916,12 @@ class BatchEngine:
 
             stepper = self._sharded_steppers.get(self.config)
             if stepper is None:
-                stepper = sharded_batch_step(self.config, self.mesh)
+                stepper = sharded_batch_step(
+                    self.config,
+                    self.mesh,
+                    kernel=self.kernel,
+                    pallas_interpret=self._pallas_interpret,
+                )
                 self._sharded_steppers[self.config] = stepper
             return stepper(books, shard_batch(self.mesh, ops))
         if self.kernel == "pallas":

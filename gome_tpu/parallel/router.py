@@ -96,6 +96,11 @@ class ShardedEngine:
         merged.sort(key=lambda kv: kv[0])
         return [ev for _, evs in merged for ev in evs]
 
+    def process_columnar(self, orders: list[Order]):
+        """Columnar facade parity with MatchEngine (the consumer publishes
+        through the EventBatch surface; the wrapper provides it)."""
+        return _ResultsBatch(self.process(orders))
+
     def process_frame(self, cols: dict):
         """ORDER-frame ingestion on the in-process sharded facade: decodes
         to Orders and runs the exact object path — admission semantics

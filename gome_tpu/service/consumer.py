@@ -194,11 +194,12 @@ class OrderConsumer:
             return 0
 
     def quarantine_once(self) -> int:
-        """Replay the head batch one order at a time, dead-lettering each
-        order whose ENGINE processing deterministically fails (logged with
-        its wire body + counted in gome_poison_orders_total) and committing
-        past it — the stream advances even when an order poisons batch
-        processing. Healthy orders still match and publish normally.
+        """Replay the head batch isolating poison ORDERS by bisection:
+        a failing chunk splits in half (FIFO preserved) until the failing
+        singleton is found, which is dead-lettered (logged + counted in
+        gome_poison_orders_total, its pre-pool mark cleared) — the stream
+        advances past it while every healthy order in the same message
+        (a 256K-order frame included) still matches and publishes.
 
         A publish failure is NOT a poison order: the quarantine pass stops
         without committing that offset (standard at-least-once replay — the
@@ -209,64 +210,77 @@ class OrderConsumer:
         from ..bus.colwire import decode_order_frame, is_frame
 
         for m in msgs:
-            orders = []
-            decode_for_unmark = lambda: []  # replaced once decode succeeds
             try:
                 if is_frame(m.body):
-                    cols = decode_order_frame(m.body)
-                    run = lambda: self.engine.process_frame(cols)
+                    from ..engine.frames import orders_from_frame
 
-                    def decode_for_unmark(_cols=cols):
-                        from ..engine.frames import orders_from_frame
-
-                        return orders_from_frame(_cols)
-
+                    orders = orders_from_frame(decode_order_frame(m.body))
                 else:
                     orders = decode_orders_batch([m.body])
-                    run = lambda: self.engine.process_columnar(orders)
-                    decode_for_unmark = lambda: orders
-                try:
-                    batch = run()
-                except Exception:
-                    # Confirm determinism with one retry before discarding:
-                    # a transient fault (device hiccup) must not cost a
-                    # healthy order. The failed attempt rolled back.
-                    batch = run()
             except Exception:
+                # Undecodable message: nothing to salvage.
                 _poisoned.inc(1)
                 log.exception(
-                    "dead-lettering poison order at offset %d: %r",
-                    m.offset, m.body,
+                    "dead-lettering undecodable message at offset %d",
+                    m.offset,
                 )
-                # The failed engine call restored its consumed pre-pool
-                # marks; a dead-lettered ADD will never be replayed, so its
-                # mark must not linger (it would persist into snapshots as
-                # a live queued ADD). Frames decode here too — only for
-                # this rare dead-letter path.
-                unmark = getattr(self.engine, "unmark", None)
-                if unmark is not None:
-                    try:
-                        for o in decode_for_unmark():
-                            unmark(o)
-                    except Exception:
-                        log.exception("could not unmark dead-lettered orders")
                 self.bus.order_queue.commit(m.offset + 1)
                 continue
-            try:
-                self._publish(batch)
-            except Exception:
-                log.exception(
-                    "publish failed during quarantine at offset %d; "
-                    "leaving offset for replay", m.offset,
-                )
-                return processed
+            ok, n_ok = self._bisect_apply(orders)
+            if not ok:
+                return processed  # publish hiccup: leave offset for replay
             self.bus.order_queue.commit(m.offset + 1)
-            processed += 1
-            _orders_total.inc(1)
-            _events_total.inc(len(batch))
+            processed += n_ok
+            _orders_total.inc(n_ok)
             if self.on_batch is not None:
-                self.on_batch(1, len(batch))
+                self.on_batch(n_ok, 0)
         return processed
+
+    def _bisect_apply(self, orders) -> tuple[bool, int]:
+        """Process `orders` in FIFO order, bisecting around failures until
+        poison singletons are isolated and dead-lettered. Returns
+        (publish_ok, orders_processed); publish_ok=False means the match
+        queue failed and the caller must not commit (engine work already
+        applied rides the at-least-once replay window)."""
+        if not orders:
+            return True, 0
+        try:
+            batch = self.engine.process_columnar(orders)
+        except Exception:
+            if len(orders) == 1:
+                order = orders[0]
+                try:  # confirm determinism: transient faults retry clean
+                    batch = self.engine.process_columnar(orders)
+                except Exception:
+                    _poisoned.inc(1)
+                    log.exception(
+                        "dead-lettering poison order oid=%s symbol=%s",
+                        order.oid, order.symbol,
+                    )
+                    # The failed call restored its consumed pre-pool mark;
+                    # a dead-lettered ADD will never be replayed, so the
+                    # mark must not linger (it would persist into
+                    # snapshots as a live queued ADD).
+                    unmark = getattr(self.engine, "unmark", None)
+                    if unmark is not None:
+                        unmark(order)
+                    return True, 0
+            else:
+                mid = len(orders) // 2
+                ok, a = self._bisect_apply(orders[:mid])
+                if not ok:
+                    return False, a
+                ok, b = self._bisect_apply(orders[mid:])
+                return ok, a + b
+        try:
+            self._publish(batch)
+        except Exception:
+            log.exception(
+                "publish failed during quarantine; leaving offset for replay"
+            )
+            return False, 0
+        _events_total.inc(len(batch))
+        return True, len(orders)
 
     def stop(self) -> None:
         self._stop.set()

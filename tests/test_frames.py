@@ -274,6 +274,24 @@ def test_frame_admission_cancel_race():
     assert int(np.asarray(engine.books.count).sum()) == 0
 
 
+def test_event_frame_non_ascii_ids():
+    """UTF-8 ids survive both frame codecs (np 'S' conversion is
+    ASCII-only on str inputs; the packers must encode first)."""
+    orders = [
+        Order(uuid="пользователь", oid="ордер-1", symbol="эфир2usdt",
+              side=Side.SALE, price=100, volume=5),
+        Order(uuid="用户", oid="订单-2", symbol="эфир2usdt",
+              side=Side.BUY, price=100, volume=3),
+    ]
+    eng = BatchEngine(BookConfig(cap=16, max_fills=4), n_slots=16, max_t=4)
+    batch = process_frame(
+        eng, colwire.decode_order_frame(orders_to_frame(orders))
+    )
+    back = colwire.decode_event_frame(colwire.encode_event_frame(batch))
+    assert back.to_results() == batch.to_results() == _oracle(orders)
+    assert back.to_results()[0].match_node.uuid == "пользователь"
+
+
 def test_order_frame_codec_edge_cases():
     # empty batch
     payload = orders_to_frame([])
