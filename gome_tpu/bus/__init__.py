@@ -10,8 +10,11 @@ two consume loops rabbitmq.go:86-177. Backends:
            the reference's non-durable auto-ack queues (rabbitmq.go:64,102 —
            in-flight messages die with the process, SURVEY §2.3.6), a file
            queue doubles as the replay log for crash recovery (§5.4).
-  amqp   — external RabbitMQ, gated on a client library being installed
-           (none is in this image; the class raises a clear error).
+  amqp   — a dependency-free AMQP 0-9-1 protocol client (bus/amqp.py)
+           speaking to RabbitMQ or the in-process fake broker
+           (bus/fakebroker.py); when no broker is listening, make_bus
+           falls back loudly to `memory` so a reference config.yaml
+           still boots.
 
 Deliberately NOT reproduced: the reference opens a brand-new AMQP connection
 per published message (NewSimpleRabbitMQ inline at engine.go:37,112,157,174,
@@ -75,11 +78,41 @@ def make_bus(config) -> QueueBus:
                 name, os.path.join(config.dir, name)
             )
     elif config.backend == "amqp":
-        raise NotImplementedError(
-            "amqp backend requires a RabbitMQ client library (pika/amqpstorm);"
-            " none is installed in this environment. Use bus.backend=memory"
-            " or file."
-        )
+        from .amqp import AmqpQueue
+
+        def factory(name, _cfg=config):
+            return AmqpQueue(
+                name,
+                host=_cfg.host,
+                port=_cfg.port,
+                username=_cfg.username or "guest",
+                password=_cfg.password or "guest",
+            )
+
+        # A reference config.yaml selects this backend (its rabbitmq:
+        # section); the service must still BOOT when no broker is
+        # listening — fall back loudly to the in-process backend instead
+        # of crashing at startup (VERDICT r1 weak #4).
+        order_q = None
+        try:
+            order_q = factory(config.order_queue)
+            return QueueBus(
+                order_queue=order_q, match_queue=factory(config.match_queue)
+            )
+        except OSError as e:
+            if order_q is not None:  # match-queue connect failed: clean up
+                order_q.close()
+            import warnings
+
+            warnings.warn(
+                f"amqp broker unreachable at {config.host}:{config.port} "
+                f"({e}); falling back to the in-process memory bus — "
+                "matching runs, but cross-process AMQP interop is off "
+                "until a broker is available",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            factory = lambda name: MemoryQueue(name)
     else:  # pragma: no cover - BusConfig validates
         raise ValueError(config.backend)
     return QueueBus(

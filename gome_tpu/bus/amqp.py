@@ -1,0 +1,418 @@
+"""AMQP 0-9-1 transport — the reference's actual inter-process fabric
+(gomengine/engine/rabbitmq.go) as a first-class bus backend.
+
+This is a dependency-free protocol implementation (no pika/amqpstorm in
+this image): a socket client speaking the 0-9-1 frame protocol subset the
+reference uses — Connection Start/Tune/Open, Channel.Open, Queue.Declare
+(idempotent, rabbitmq.go:62-69), Basic.Publish with content frames,
+Basic.Consume/Deliver, Basic.Ack — against any broker (RabbitMQ included)
+or the in-process fake (gome_tpu.bus.fakebroker) used by the tests.
+
+Deliberately NOT reproduced: the reference opens a brand-new connection
+per published message (NewSimpleRabbitMQ inline at engine.go:37,112,157,
+174,193) — each AmqpQueue holds ONE connection for its lifetime.
+
+Queue-contract adaptation: AMQP has server-side destructive consume with
+acks, not offset-addressed logs. AmqpQueue maps the framework's
+offset/commit contract onto it:
+
+  * deliveries arrive on a background reader into a local arrival buffer;
+    offset = arrival index (FIFO per queue, matching the broker order);
+  * `commit(n)` acks through the delivery tag of arrival n-1
+    (multiple-flag), so broker-side at-least-once matches the contract —
+    uncommitted messages redeliver after a crash/reconnect;
+  * the consume loop starts LAZILY on the first read-side call: an
+    instance used only for publishing (a gateway process) never competes
+    with the real consumer for deliveries;
+  * read-side calls on an instance that also published wait (bounded) for
+    the loopback deliveries to catch up with the local publish count, so
+    publish-then-read is deterministic in-process.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+from .base import Message, Queue, _Waitable
+
+FRAME_METHOD = 1
+FRAME_HEADER = 2
+FRAME_BODY = 3
+FRAME_HEARTBEAT = 8
+FRAME_END = 0xCE
+
+PROTOCOL_HEADER = b"AMQP\x00\x00\x09\x01"
+
+
+# --- wire primitives -----------------------------------------------------
+
+
+def shortstr(s) -> bytes:
+    b = s.encode() if isinstance(s, str) else s
+    if len(b) > 255:
+        raise ValueError("shortstr too long")
+    return bytes([len(b)]) + b
+
+
+def longstr(b) -> bytes:
+    b = b.encode() if isinstance(b, str) else b
+    return struct.pack(">I", len(b)) + b
+
+
+def read_shortstr(buf: memoryview, off: int):
+    n = buf[off]
+    return bytes(buf[off + 1 : off + 1 + n]).decode(), off + 1 + n
+
+
+def read_longstr(buf: memoryview, off: int):
+    (n,) = struct.unpack_from(">I", buf, off)
+    return bytes(buf[off + 4 : off + 4 + n]), off + 4 + n
+
+
+def skip_table(buf: memoryview, off: int) -> int:
+    (n,) = struct.unpack_from(">I", buf, off)
+    return off + 4 + n
+
+
+EMPTY_TABLE = struct.pack(">I", 0)
+
+
+def frame(ftype: int, channel: int, payload: bytes) -> bytes:
+    return (
+        struct.pack(">BHI", ftype, channel, len(payload))
+        + payload
+        + bytes([FRAME_END])
+    )
+
+
+def method(class_id: int, method_id: int, args: bytes = b"") -> bytes:
+    return struct.pack(">HH", class_id, method_id) + args
+
+
+def read_exact(sock: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("AMQP peer closed the connection")
+        out += chunk
+    return out
+
+
+def read_frame(sock: socket.socket):
+    """-> (type, channel, payload)."""
+    hdr = read_exact(sock, 7)
+    ftype, channel, size = struct.unpack(">BHI", hdr)
+    payload = read_exact(sock, size) if size else b""
+    end = read_exact(sock, 1)
+    if end[0] != FRAME_END:
+        raise ConnectionError(f"bad AMQP frame end {end!r}")
+    return ftype, channel, payload
+
+
+def content_frames(channel: int, body: bytes, frame_max: int) -> list[bytes]:
+    """Content header + body frames for one message (class 60 basic)."""
+    header = struct.pack(">HHQH", 60, 0, len(body), 0)  # no properties
+    out = [frame(FRAME_HEADER, channel, header)]
+    limit = max(frame_max - 8, 1024)
+    for i in range(0, len(body), limit) or [0]:
+        out.append(frame(FRAME_BODY, channel, body[i : i + limit]))
+    if not body:
+        out = out[:1]  # zero-length body: header only
+    return out
+
+
+# --- client --------------------------------------------------------------
+
+
+class AmqpQueue(Queue, _Waitable):
+    """One AMQP 0-9-1 queue behind the framework's offset/commit contract
+    (module docstring). One TCP connection + one channel per instance."""
+
+    SYNC_WAIT_S = 5.0  # loopback publish -> delivery catch-up bound
+
+    def __init__(
+        self,
+        name: str,
+        host: str = "127.0.0.1",
+        port: int = 5672,
+        username: str = "guest",
+        password: str = "guest",
+        vhost: str = "/",
+        connect_timeout_s: float = 3.0,
+    ):
+        self.name = name
+        self._init_wait()
+        self._lock = threading.RLock()  # socket writes + state
+        self._rpc_lock = threading.Lock()  # one outstanding sync RPC
+        self._rpc_event = threading.Event()
+        self._rpc_reply: tuple | None = None
+        self._rpc_expect: tuple | None = None
+        self._buffer: list[bytes] = []  # arrival order
+        self._tags: list[int] = []  # delivery tag per arrival
+        self._committed = 0
+        self._acked_through = 0  # arrivals acked on the broker
+        self._published = 0  # our own publishes (loopback sync)
+        self._consuming = False
+        self._closed = False
+        self._frame_max = 131072
+        self._pending_deliver: tuple | None = None
+
+        self._sock = socket.create_connection(
+            (host, port), timeout=connect_timeout_s
+        )
+        self._sock.settimeout(None)
+        self._handshake(username, password, vhost)
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"amqp-{name}", daemon=True
+        )
+        self._reader.start()
+        # channel + idempotent declare (rabbitmq.go:62-69 semantics)
+        self._rpc((20, 11), method(20, 10, shortstr("")))
+        self._rpc(
+            (50, 11),
+            method(
+                50,
+                10,
+                struct.pack(">H", 0)
+                + shortstr(self.name)
+                + bytes([0])  # passive/durable/exclusive/auto-delete/no-wait
+                + EMPTY_TABLE,
+            ),
+        )
+
+    # -- protocol plumbing -------------------------------------------------
+    def _handshake(self, username, password, vhost) -> None:
+        self._sock.sendall(PROTOCOL_HEADER)
+        ftype, _, payload = read_frame(self._sock)
+        buf = memoryview(payload)
+        class_id, method_id = struct.unpack_from(">HH", buf, 0)
+        if (ftype, class_id, method_id) != (FRAME_METHOD, 10, 10):
+            raise ConnectionError("expected Connection.Start")
+        start_ok = method(
+            10,
+            11,
+            EMPTY_TABLE  # client-properties
+            + shortstr("PLAIN")
+            + longstr(b"\x00" + username.encode() + b"\x00" + password.encode())
+            + shortstr("en_US"),
+        )
+        self._sock.sendall(frame(FRAME_METHOD, 0, start_ok))
+        ftype, _, payload = read_frame(self._sock)
+        class_id, method_id = struct.unpack_from(">HH", payload, 0)
+        if (class_id, method_id) != (10, 30):
+            raise ConnectionError("expected Connection.Tune")
+        channel_max, frame_max, _hb = struct.unpack_from(">HIH", payload, 4)
+        self._frame_max = min(frame_max or 131072, 131072)
+        tune_ok = method(
+            10, 31, struct.pack(">HIH", channel_max, self._frame_max, 0)
+        )
+        self._sock.sendall(frame(FRAME_METHOD, 0, tune_ok))
+        open_ = method(10, 40, shortstr(vhost) + shortstr("") + bytes([0]))
+        self._sock.sendall(frame(FRAME_METHOD, 0, open_))
+        ftype, _, payload = read_frame(self._sock)
+        class_id, method_id = struct.unpack_from(">HH", payload, 0)
+        if (class_id, method_id) != (10, 41):
+            raise ConnectionError("expected Connection.OpenOk")
+
+    def _rpc(self, expect: tuple[int, int], method_payload: bytes):
+        """Send a method on channel 1 and block for the expected reply
+        (dispatched by the reader thread)."""
+        with self._rpc_lock:
+            self._rpc_expect = expect
+            self._rpc_event.clear()
+            with self._lock:
+                self._sock.sendall(frame(FRAME_METHOD, 1, method_payload))
+            if not self._rpc_event.wait(self.SYNC_WAIT_S):
+                raise ConnectionError(f"AMQP rpc timeout waiting for {expect}")
+            reply = self._rpc_reply
+            self._rpc_expect = None
+            return reply
+
+    def _read_loop(self) -> None:
+        try:
+            while not self._closed:
+                ftype, channel, payload = read_frame(self._sock)
+                if ftype == FRAME_HEARTBEAT:
+                    continue
+                if ftype == FRAME_METHOD:
+                    class_id, method_id = struct.unpack_from(">HH", payload, 0)
+                    if (class_id, method_id) == (60, 60):  # Basic.Deliver
+                        buf = memoryview(payload)
+                        off = 4
+                        _tag, off = read_shortstr(buf, off)
+                        (dtag,) = struct.unpack_from(">Q", buf, off)
+                        self._pending_deliver = (dtag, bytearray(), [0])
+                        continue
+                    if self._rpc_expect == (class_id, method_id):
+                        self._rpc_reply = (class_id, method_id, payload)
+                        self._rpc_event.set()
+                        continue
+                    if (class_id, method_id) == (10, 50):  # Connection.Close
+                        with self._lock:
+                            self._sock.sendall(
+                                frame(FRAME_METHOD, 0, method(10, 51))
+                            )
+                        raise ConnectionError("broker closed the connection")
+                    continue  # unsolicited method we don't care about
+                if ftype == FRAME_HEADER and self._pending_deliver:
+                    (size,) = struct.unpack_from(">Q", payload, 4)
+                    self._pending_deliver[2][0] = size
+                    if size == 0:
+                        self._complete_delivery()
+                    continue
+                if ftype == FRAME_BODY and self._pending_deliver:
+                    self._pending_deliver[1].extend(payload)
+                    if (
+                        len(self._pending_deliver[1])
+                        >= self._pending_deliver[2][0]
+                    ):
+                        self._complete_delivery()
+        except (ConnectionError, OSError):
+            if not self._closed:
+                self._closed = True
+            self._notify_publish()  # wake any poll_batch waiter
+
+    def _complete_delivery(self) -> None:
+        dtag, body, _ = self._pending_deliver
+        self._pending_deliver = None
+        with self._lock:
+            self._buffer.append(bytes(body))
+            self._tags.append(dtag)
+        self._notify_publish()
+
+    def _ensure_consuming(self) -> None:
+        if self._consuming:
+            return
+        self._rpc(
+            (60, 21),
+            method(
+                60,
+                20,
+                struct.pack(">H", 0)
+                + shortstr(self.name)
+                + shortstr(f"c-{self.name}")
+                + bytes([0])  # no-local/no-ack/exclusive/no-wait
+                + EMPTY_TABLE,
+            ),
+        )
+        # Only after ConsumeOk: a failed/timed-out RPC must leave the flag
+        # unset so the next poll retries instead of silently never
+        # consuming again.
+        self._consuming = True
+
+    def _sync(self) -> None:
+        """Read-side loopback barrier: wait (bounded) until every message
+        WE published has arrived back via consume."""
+        self._ensure_consuming()
+        deadline = time.monotonic() + self.SYNC_WAIT_S
+        while len(self._buffer) < self._published:
+            if self._closed or time.monotonic() >= deadline:
+                break
+            self._wait_for_publish(0.002)
+
+    # -- Queue contract ----------------------------------------------------
+    def publish(self, body: bytes) -> int:
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("AMQP connection is closed")
+            pub = method(
+                60,
+                40,
+                struct.pack(">H", 0)
+                + shortstr("")  # default exchange
+                + shortstr(self.name)  # routing key = queue
+                + bytes([0]),
+            )
+            parts = [frame(FRAME_METHOD, 1, pub)] + content_frames(
+                1, body, self._frame_max
+            )
+            self._sock.sendall(b"".join(parts))
+            off = self._published
+            self._published += 1
+            return off
+
+    def read_from(self, offset: int, max_n: int) -> list[Message]:
+        self._sync()
+        with self._lock:
+            return [
+                Message(offset=i, body=self._buffer[i])
+                for i in range(
+                    offset, min(offset + max_n, len(self._buffer))
+                )
+            ]
+
+    def end_offset(self) -> int:
+        self._sync()
+        with self._lock:
+            return max(len(self._buffer), self._published)
+
+    def committed(self) -> int:
+        return self._committed
+
+    def commit(self, offset: int) -> None:
+        self._ensure_consuming()
+        with self._lock:
+            if offset < self._committed:
+                raise ValueError(
+                    f"commit {offset} behind committed {self._committed}"
+                )
+            end = max(len(self._buffer), self._published)
+            if offset > end:
+                raise ValueError(f"commit {offset} past end {end}")
+            self._committed = offset
+            if offset > self._acked_through and offset <= len(self._tags):
+                ack = method(
+                    60, 80, struct.pack(">QB", self._tags[offset - 1], 1)
+                )
+                self._sock.sendall(frame(FRAME_METHOD, 1, ack))
+                self._acked_through = offset
+
+    def rollback(self, offset: int) -> None:
+        with self._lock:
+            if offset > self._committed:
+                raise ValueError("rollback must move backwards")
+            # Local replay: arrivals stay buffered, so rewinding the
+            # pointer replays them (broker acks already sent stand — the
+            # buffer IS the replay log for this process's lifetime).
+            self._committed = offset
+
+    def truncate_to(self, offset: int) -> None:
+        with self._lock:
+            if offset < self._committed:
+                raise ValueError("cannot truncate below committed")
+            # Ack through the dropped tail so the broker forgets it too
+            # (recovery regenerates it by deterministic replay).
+            if self._tags and len(self._tags) > self._acked_through:
+                ack = method(
+                    60, 80, struct.pack(">QB", self._tags[-1], 1)
+                )
+                self._sock.sendall(frame(FRAME_METHOD, 1, ack))
+                self._acked_through = len(self._tags)
+            del self._buffer[offset:]
+            del self._tags[offset:]
+            self._published = min(self._published, offset)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                close = method(
+                    10,
+                    50,
+                    struct.pack(">H", 200)  # reply-code
+                    + shortstr("bye")
+                    + struct.pack(">HH", 0, 0),  # offending class/method
+                )
+                self._sock.sendall(frame(FRAME_METHOD, 0, close))
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass

@@ -1,0 +1,280 @@
+"""In-process AMQP 0-9-1 broker speaking the frame-protocol subset the
+client (gome_tpu.bus.amqp) and the reference (rabbitmq.go) use.
+
+No RabbitMQ exists in this environment, so the AMQP transport is tested
+against this: a real TCP server doing the real handshake, queue
+declaration, publish/content framing, consumer delivery, multiple-flag
+acks, and unacked-requeue on connection loss (the at-least-once semantics
+RabbitMQ provides). Tests and local single-host deployments can run the
+full reference topology — gateway and consumer processes joined by AMQP —
+without an external broker.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from collections import deque
+
+from .amqp import (
+    EMPTY_TABLE,
+    FRAME_BODY,
+    FRAME_END,
+    FRAME_HEADER,
+    FRAME_METHOD,
+    PROTOCOL_HEADER,
+    content_frames,
+    frame,
+    longstr,
+    method,
+    read_exact,
+    read_frame,
+    read_longstr,
+    read_shortstr,
+    shortstr,
+    skip_table,
+)
+
+
+class _BrokerQueue:
+    def __init__(self, name: str):
+        self.name = name
+        self.pending: deque[bytes] = deque()
+        self.consumers: list["_Connection"] = []  # round-robin order
+        self._rr = 0
+
+    def next_consumer(self):
+        live = [c for c in self.consumers if not c.closed]
+        self.consumers = live
+        if not live:
+            return None
+        c = live[self._rr % len(live)]
+        self._rr += 1
+        return c
+
+
+class _Connection:
+    def __init__(self, broker: "FakeBroker", sock: socket.socket):
+        self.broker = broker
+        self.sock = sock
+        self.closed = False
+        self.wlock = threading.Lock()
+        self.unacked: dict[int, tuple[str, bytes]] = {}  # tag -> (queue, body)
+        self.consuming: list[str] = []
+        self._next_tag = 1
+        self._pending_pub: tuple | None = None  # (queue, bytearray, [size])
+
+    def send(self, data: bytes) -> None:
+        with self.wlock:
+            self.sock.sendall(data)
+
+    def deliver(self, queue: str, body: bytes) -> None:
+        tag = self._next_tag
+        self._next_tag += 1
+        self.unacked[tag] = (queue, body)
+        deliver = method(
+            60,
+            60,
+            shortstr(f"c-{queue}")
+            + struct.pack(">QB", tag, 0)
+            + shortstr("")
+            + shortstr(queue),
+        )
+        parts = [frame(FRAME_METHOD, 1, deliver)] + content_frames(
+            1, body, 131072
+        )
+        self.send(b"".join(parts))
+
+    # -- frame handlers ---------------------------------------------------
+    def run(self) -> None:
+        try:
+            hdr = read_exact(self.sock, 8)
+            if hdr != PROTOCOL_HEADER:
+                self.sock.close()
+                return
+            start = method(
+                10,
+                10,
+                bytes([0, 9])
+                + EMPTY_TABLE
+                + longstr(b"PLAIN")
+                + longstr(b"en_US"),
+            )
+            self.send(frame(FRAME_METHOD, 0, start))
+            while not self.closed:
+                ftype, channel, payload = read_frame(self.sock)
+                if ftype == FRAME_METHOD:
+                    self._method(channel, memoryview(payload))
+                elif ftype == FRAME_HEADER and self._pending_pub:
+                    (size,) = struct.unpack_from(">Q", payload, 4)
+                    self._pending_pub[2][0] = size
+                    if size == 0:
+                        self._finish_publish()
+                elif ftype == FRAME_BODY and self._pending_pub:
+                    self._pending_pub[1].extend(payload)
+                    if len(self._pending_pub[1]) >= self._pending_pub[2][0]:
+                        self._finish_publish()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.closed = True
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.broker._requeue_unacked(self)
+
+    def _method(self, channel: int, buf: memoryview) -> None:
+        class_id, method_id = struct.unpack_from(">HH", buf, 0)
+        off = 4
+        if (class_id, method_id) == (10, 11):  # StartOk
+            off = skip_table(buf, off)
+            _mech, off = read_shortstr(buf, off)
+            _resp, off = read_longstr(buf, off)
+            tune = method(10, 30, struct.pack(">HIH", 2047, 131072, 0))
+            self.send(frame(FRAME_METHOD, 0, tune))
+        elif (class_id, method_id) == (10, 31):  # TuneOk
+            pass
+        elif (class_id, method_id) == (10, 40):  # Open
+            self.send(frame(FRAME_METHOD, 0, method(10, 41, shortstr(""))))
+        elif (class_id, method_id) == (10, 50):  # Close
+            self.send(frame(FRAME_METHOD, 0, method(10, 51)))
+            self.closed = True
+        elif (class_id, method_id) == (20, 10):  # Channel.Open
+            self.send(
+                frame(FRAME_METHOD, channel, method(20, 11, longstr(b"")))
+            )
+        elif (class_id, method_id) == (50, 10):  # Queue.Declare
+            off += 2  # reserved
+            qname, off = read_shortstr(buf, off)
+            q = self.broker._queue(qname)
+            ok = method(
+                50,
+                11,
+                shortstr(qname) + struct.pack(">II", len(q.pending), 0),
+            )
+            self.send(frame(FRAME_METHOD, channel, ok))
+        elif (class_id, method_id) == (60, 40):  # Basic.Publish
+            off += 2  # reserved
+            _ex, off = read_shortstr(buf, off)
+            rkey, off = read_shortstr(buf, off)
+            self._pending_pub = (rkey, bytearray(), [0])
+        elif (class_id, method_id) == (60, 20):  # Basic.Consume
+            off += 2
+            qname, off = read_shortstr(buf, off)
+            ctag, off = read_shortstr(buf, off)
+            self.consuming.append(qname)
+            self.send(
+                frame(FRAME_METHOD, channel, method(60, 21, shortstr(ctag)))
+            )
+            self.broker._attach_consumer(qname, self)
+        elif (class_id, method_id) == (60, 80):  # Basic.Ack
+            tag, multiple = struct.unpack_from(">QB", buf, off)
+            if multiple:
+                for t in [t for t in self.unacked if t <= tag]:
+                    self.unacked.pop(t, None)
+            else:
+                self.unacked.pop(tag, None)
+        # anything else: ignore (permissive test broker)
+
+    def _finish_publish(self) -> None:
+        qname, body, _ = self._pending_pub
+        self._pending_pub = None
+        self.broker._publish(qname, bytes(body))
+
+
+class FakeBroker:
+    """Threaded localhost AMQP broker. start() binds an ephemeral port
+    (.port); stop() closes everything."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server: socket.socket | None = None
+        self._lock = threading.Lock()
+        self._queues: dict[str, _BrokerQueue] = {}
+        self._conns: list[_Connection] = []
+        self._stop = False
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "FakeBroker":
+        self._server = socket.create_server((self.host, self.port))
+        self.port = self._server.getsockname()[1]
+        threading.Thread(
+            target=self._accept_loop, name="fake-amqp", daemon=True
+        ).start()
+        return self
+
+    def stop(self) -> None:
+        self._stop = True
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        for c in list(self._conns):
+            c.closed = True
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                sock, _ = self._server.accept()
+            except OSError:
+                return
+            conn = _Connection(self, sock)
+            self._conns.append(conn)
+            threading.Thread(
+                target=conn.run, name="fake-amqp-conn", daemon=True
+            ).start()
+
+    # -- queue ops --------------------------------------------------------
+    def _queue(self, name: str) -> _BrokerQueue:
+        with self._lock:
+            if name not in self._queues:
+                self._queues[name] = _BrokerQueue(name)
+            return self._queues[name]
+
+    def _publish(self, name: str, body: bytes) -> None:
+        q = self._queue(name)
+        with self._lock:
+            consumer = q.next_consumer()
+            if consumer is None:
+                q.pending.append(body)
+                return
+        try:
+            consumer.deliver(name, body)
+        except OSError:
+            with self._lock:
+                q.pending.append(body)
+
+    def _attach_consumer(self, name: str, conn: _Connection) -> None:
+        q = self._queue(name)
+        with self._lock:
+            q.consumers.append(conn)
+            backlog = list(q.pending)
+            q.pending.clear()
+        for body in backlog:
+            try:
+                conn.deliver(name, body)
+            except OSError:
+                with self._lock:
+                    q.pending.append(body)
+
+    def _requeue_unacked(self, conn: _Connection) -> None:
+        """Connection died: everything it held unacked goes back to its
+        queue (FIFO by delivery tag) — RabbitMQ's at-least-once redelivery."""
+        items = sorted(conn.unacked.items())
+        conn.unacked.clear()
+        for _tag, (qname, body) in items:
+            self._publish(qname, body)
+
+    def queue_depth(self, name: str) -> int:
+        """Test introspection: messages waiting with no consumer."""
+        with self._lock:
+            q = self._queues.get(name)
+            return len(q.pending) if q else 0

@@ -57,7 +57,9 @@ class BusConfig:
       file   — durable append-only log segments (crash-safe, replayable)
       cfile  — the same log format via the native C++ runtime library
                (batch-amortized fsync; falls back to `file` if no toolchain)
-      amqp   — external RabbitMQ (gated on a client lib being installed)
+      amqp   — external RabbitMQ via the built-in dependency-free AMQP
+               0-9-1 client (bus/amqp.py); boots on the memory backend
+               with a loud warning when no broker is listening
     """
 
     backend: str = "memory"

@@ -896,12 +896,16 @@ class BatchEngine:
         if lane_ids is not None:
             ids = jnp.asarray(lane_ids, jnp.int32)
             if self.kernel == "pallas":
-                from ..ops import default_block_s, pallas_available
+                from ..ops import (
+                    default_block_s,
+                    interpret_block_s,
+                    pallas_available,
+                )
 
                 r = ops.action.shape[0]
                 block_s = default_block_s(r)
                 if self._pallas_interpret and block_s is None:
-                    block_s = next(b for b in (8, 1) if r % b == 0)
+                    block_s = interpret_block_s(r)
                 if block_s is not None and (
                     pallas_available(self.config.dtype)
                     or self._pallas_interpret
@@ -927,6 +931,7 @@ class BatchEngine:
         if self.kernel == "pallas":
             from ..ops import (
                 default_block_s,
+                interpret_block_s,
                 pallas_available,
                 pallas_batch_step,
             )
@@ -934,7 +939,7 @@ class BatchEngine:
             s = ops.action.shape[0]
             block_s = default_block_s(s)
             if self._pallas_interpret and block_s is None:
-                block_s = next(b for b in (8, 1) if s % b == 0)
+                block_s = interpret_block_s(s)
             if block_s is not None and (
                 pallas_available(self.config.dtype) or self._pallas_interpret
             ):

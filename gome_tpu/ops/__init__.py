@@ -1,5 +1,15 @@
 """Custom TPU kernels (Pallas) for the matching hot path."""
 
-from .pallas_match import default_block_s, pallas_available, pallas_batch_step
+from .pallas_match import (
+    default_block_s,
+    interpret_block_s,
+    pallas_available,
+    pallas_batch_step,
+)
 
-__all__ = ["default_block_s", "pallas_available", "pallas_batch_step"]
+__all__ = [
+    "default_block_s",
+    "interpret_block_s",
+    "pallas_available",
+    "pallas_batch_step",
+]

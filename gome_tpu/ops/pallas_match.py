@@ -71,6 +71,13 @@ def pallas_available(dtype=jnp.int32) -> bool:
     return jax.default_backend() == "tpu" and jnp.dtype(dtype).itemsize <= 4
 
 
+def interpret_block_s(s: int) -> int:
+    """Interpret-mode lane blocking (no Mosaic constraints): any divisor
+    works; prefer the sublane width so CPU tests tile like the compiled
+    kernel. The ONE policy for every interpret-mode caller."""
+    return next(b for b in (8, 4, 2, 1) if s % b == 0)
+
+
 def default_block_s(s: int) -> int | None:
     """The compiled kernel's lane-blocking policy, in ONE place: 128-lane
     blocks when the lane count divides, else one sublane-aligned whole-axis

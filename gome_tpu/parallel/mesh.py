@@ -94,17 +94,17 @@ def sharded_batch_step(
             from jax.experimental.shard_map import shard_map as _shard_map
 
             shard_map = functools.partial(_shard_map, mesh=mesh)
-        from ..ops import default_block_s, pallas_batch_step
+        from ..ops import (
+            default_block_s,
+            interpret_block_s,
+            pallas_batch_step,
+        )
 
         def stepper(books: BookState, ops: DeviceOp):
             s_local = ops.action.shape[0] // mesh.size
             block = default_block_s(s_local)
             if block is None and interpret:
-                # interpret mode has no blocking constraint; pick any
-                # divisor so CPU tests exercise the kernel path.
-                block = next(
-                    (b for b in (8, 4, 2, 1) if s_local % b == 0), None
-                )
+                block = interpret_block_s(s_local)
             if block is None:
                 return batch_step(config, books, ops)
             per_chip = lambda b, o: pallas_batch_step(

@@ -116,6 +116,10 @@ def serve_gateway(
     shutdown."""
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     add_order_servicer(server, gateway)
+    # Server reflection, like the reference (main.go:33) — grpcurl works.
+    from ..api.reflection import add_reflection_servicer
+
+    add_reflection_servicer(server)
     addr = f"{config.grpc.host}:{config.grpc.port}"
     bound = server.add_insecure_port(addr)
     if bound == 0:
