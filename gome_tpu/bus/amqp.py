@@ -114,14 +114,13 @@ def read_frame(sock: socket.socket):
 
 
 def content_frames(channel: int, body: bytes, frame_max: int) -> list[bytes]:
-    """Content header + body frames for one message (class 60 basic)."""
+    """Content header + body frames for one message (class 60 basic).
+    Zero-length bodies are header-only."""
     header = struct.pack(">HHQH", 60, 0, len(body), 0)  # no properties
     out = [frame(FRAME_HEADER, channel, header)]
     limit = max(frame_max - 8, 1024)
-    for i in range(0, len(body), limit) or [0]:
+    for i in range(0, len(body), limit):
         out.append(frame(FRAME_BODY, channel, body[i : i + limit]))
-    if not body:
-        out = out[:1]  # zero-length body: header only
     return out
 
 
@@ -164,25 +163,35 @@ class AmqpQueue(Queue, _Waitable):
         self._sock = socket.create_connection(
             (host, port), timeout=connect_timeout_s
         )
-        self._sock.settimeout(None)
-        self._handshake(username, password, vhost)
-        self._reader = threading.Thread(
-            target=self._read_loop, name=f"amqp-{name}", daemon=True
-        )
-        self._reader.start()
-        # channel + idempotent declare (rabbitmq.go:62-69 semantics)
-        self._rpc((20, 11), method(20, 10, shortstr("")))
-        self._rpc(
-            (50, 11),
-            method(
-                50,
-                10,
-                struct.pack(">H", 0)
-                + shortstr(self.name)
-                + bytes([0])  # passive/durable/exclusive/auto-delete/no-wait
-                + EMPTY_TABLE,
-            ),
-        )
+        try:
+            self._sock.settimeout(None)
+            self._handshake(username, password, vhost)
+            self._reader = threading.Thread(
+                target=self._read_loop, name=f"amqp-{name}", daemon=True
+            )
+            self._reader.start()
+            # channel + idempotent declare (rabbitmq.go:62-69 semantics)
+            self._rpc((20, 11), method(20, 10, shortstr("")))
+            self._rpc(
+                (50, 11),
+                method(
+                    50,
+                    10,
+                    struct.pack(">H", 0)
+                    + shortstr(self.name)
+                    + bytes([0])  # passive/durable/exclusive/auto-del/no-wait
+                    + EMPTY_TABLE,
+                ),
+            )
+        except Exception:
+            # No half-open leaks: a failed handshake/declare closes the
+            # socket (which also ends the reader thread) before raising.
+            self._closed = True
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise
 
     # -- protocol plumbing -------------------------------------------------
     def _handshake(self, username, password, vhost) -> None:
@@ -384,14 +393,13 @@ class AmqpQueue(Queue, _Waitable):
         with self._lock:
             if offset < self._committed:
                 raise ValueError("cannot truncate below committed")
-            # Ack through the dropped tail so the broker forgets it too
-            # (recovery regenerates it by deterministic replay).
-            if self._tags and len(self._tags) > self._acked_through:
-                ack = method(
-                    60, 80, struct.pack(">QB", self._tags[-1], 1)
-                )
+            # Individually ack ONLY the dropped tail so the broker forgets
+            # it (recovery regenerates it by deterministic replay). A
+            # multiple-ack through the last tag would also ack the
+            # uncommitted, undropped middle — which must stay redeliverable.
+            for tag in self._tags[offset:]:
+                ack = method(60, 80, struct.pack(">QB", tag, 0))
                 self._sock.sendall(frame(FRAME_METHOD, 1, ack))
-                self._acked_through = len(self._tags)
             del self._buffer[offset:]
             del self._tags[offset:]
             self._published = min(self._published, offset)

@@ -60,6 +60,7 @@ class _Connection:
         self.sock = sock
         self.closed = False
         self.wlock = threading.Lock()
+        self.dlock = threading.Lock()  # delivery-tag + unacked consistency
         self.unacked: dict[int, tuple[str, bytes]] = {}  # tag -> (queue, body)
         self.consuming: list[str] = []
         self._next_tag = 1
@@ -70,21 +71,27 @@ class _Connection:
             self.sock.sendall(data)
 
     def deliver(self, queue: str, body: bytes) -> None:
-        tag = self._next_tag
-        self._next_tag += 1
-        self.unacked[tag] = (queue, body)
-        deliver = method(
-            60,
-            60,
-            shortstr(f"c-{queue}")
-            + struct.pack(">QB", tag, 0)
-            + shortstr("")
-            + shortstr(queue),
-        )
-        parts = [frame(FRAME_METHOD, 1, deliver)] + content_frames(
-            1, body, 131072
-        )
-        self.send(b"".join(parts))
+        # Broker threads for DIFFERENT producer connections can deliver to
+        # the same consumer concurrently: tag allocation + unacked insert +
+        # the send must be one atomic unit or tags duplicate and unacked
+        # entries vanish (breaking the redelivery guarantee this broker
+        # exists to test).
+        with self.dlock:
+            tag = self._next_tag
+            self._next_tag += 1
+            self.unacked[tag] = (queue, body)
+            deliver = method(
+                60,
+                60,
+                shortstr(f"c-{queue}")
+                + struct.pack(">QB", tag, 0)
+                + shortstr("")
+                + shortstr(queue),
+            )
+            parts = [frame(FRAME_METHOD, 1, deliver)] + content_frames(
+                1, body, 131072
+            )
+            self.send(b"".join(parts))
 
     # -- frame handlers ---------------------------------------------------
     def run(self) -> None:
@@ -171,11 +178,12 @@ class _Connection:
             self.broker._attach_consumer(qname, self)
         elif (class_id, method_id) == (60, 80):  # Basic.Ack
             tag, multiple = struct.unpack_from(">QB", buf, off)
-            if multiple:
-                for t in [t for t in self.unacked if t <= tag]:
-                    self.unacked.pop(t, None)
-            else:
-                self.unacked.pop(tag, None)
+            with self.dlock:
+                if multiple:
+                    for t in [t for t in self.unacked if t <= tag]:
+                        self.unacked.pop(t, None)
+                else:
+                    self.unacked.pop(tag, None)
         # anything else: ignore (permissive test broker)
 
     def _finish_publish(self) -> None:
@@ -242,34 +250,37 @@ class FakeBroker:
     def _publish(self, name: str, body: bytes) -> None:
         q = self._queue(name)
         with self._lock:
-            consumer = q.next_consumer()
-            if consumer is None:
-                q.pending.append(body)
-                return
-        try:
-            consumer.deliver(name, body)
-        except OSError:
-            with self._lock:
-                q.pending.append(body)
+            q.pending.append(body)
+        self._drain(q)
 
     def _attach_consumer(self, name: str, conn: _Connection) -> None:
         q = self._queue(name)
         with self._lock:
             q.consumers.append(conn)
-            backlog = list(q.pending)
-            q.pending.clear()
-        for body in backlog:
-            try:
-                conn.deliver(name, body)
-            except OSError:
-                with self._lock:
-                    q.pending.append(body)
+        self._drain(q)
+
+    def _drain(self, q: _BrokerQueue) -> None:
+        """Deliver pending messages FIFO under the broker lock — every
+        publish and consumer attach funnels through here, so a new publish
+        can never overtake an older backlog message."""
+        with self._lock:
+            while q.pending:
+                consumer = q.next_consumer()
+                if consumer is None:
+                    return
+                body = q.pending.popleft()
+                try:
+                    consumer.deliver(q.name, body)
+                except OSError:
+                    q.pending.appendleft(body)
+                    return
 
     def _requeue_unacked(self, conn: _Connection) -> None:
         """Connection died: everything it held unacked goes back to its
         queue (FIFO by delivery tag) — RabbitMQ's at-least-once redelivery."""
-        items = sorted(conn.unacked.items())
-        conn.unacked.clear()
+        with conn.dlock:
+            items = sorted(conn.unacked.items())
+            conn.unacked.clear()
         for _tag, (qname, body) in items:
             self._publish(qname, body)
 

@@ -22,12 +22,24 @@ with the process — then snapshots still restore books, and the replay tail
 is empty, which is precisely the reference's crash model: in-flight
 messages lost, book state kept, SURVEY §2.3.6).
 
-An optional Redis *export* of the book in the reference's exact key schema
-(SURVEY §2.1) lives in redis_schema (commands are generated without a
-client; applying them is gated on redis-py being installed).
+Redis interop is bidirectional: redis_schema *exports* the book in the
+reference's exact key schema (commands are generated without a client;
+applying them is gated on redis-py being installed), and redis_restore
+*imports* that schema back — a live gome deployment's Redis book migrates
+into the TPU engine, which continues matching the same symbols. DictRedis
+(redis_restore) is an offline in-memory store serving both directions in
+tests and as a snapshot target without a server.
 """
 
+from .redis_restore import DictRedis, discover_symbols, restore_from_redis
 from .snapshot import Persister, SnapshotStore
 from .redis_schema import book_redis_commands
 
-__all__ = ["Persister", "SnapshotStore", "book_redis_commands"]
+__all__ = [
+    "DictRedis",
+    "Persister",
+    "SnapshotStore",
+    "book_redis_commands",
+    "discover_symbols",
+    "restore_from_redis",
+]
