@@ -497,6 +497,33 @@ def main():
         # far from 2^31 (the documented int32-mode operating contract).
         for d in raw:
             d["volume"] = (d["volume"] // 1_000_000).astype(np_dtype)
+    # Compiled-kernel parity gate: three compiled-lowering crashes were
+    # already found by fuzzing (the lowering is the risk surface), so every
+    # TPU pallas bench certifies compiled == scan BEFORE timing and refuses
+    # to report on mismatch. BENCH_PARITY=0 skips (e.g. repeated runs in
+    # one session). CPU/interpret runs skip automatically.
+    if (
+        KERNEL == "pallas"
+        and not check
+        and os.environ.get("BENCH_PARITY", "1") != "0"
+        and jax.default_backend() == "tpu"
+        and pallas_available(config.dtype)  # the compiled kernel IS timed
+    ):
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "scripts"))
+        from tpu_parity_check import run_parity
+
+        rc = run_parity(
+            S=128, T=8, CAP=CAP, K=config.max_fills, G=2,
+            log=lambda m: print(f"# parity: {m}", file=sys.stderr),
+        )
+        if rc != 0:
+            print(
+                "# FATAL: compiled pallas kernel diverges from the scan "
+                "path — refusing to report bench numbers",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+
     # Dense-round path for the sparse/latency-bound config shapes: 1-2
     # (single live lane — deep time axis amortizes dispatch) and 4 (Zipf —
     # device work must track APPLIED ops, not the 10K provisioned lanes).

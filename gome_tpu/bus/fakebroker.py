@@ -42,6 +42,7 @@ class _BrokerQueue:
         self.name = name
         self.pending: deque[bytes] = deque()
         self.consumers: list["_Connection"] = []  # round-robin order
+        self.drain_lock = threading.Lock()  # one drainer at a time (FIFO)
         self._rr = 0
 
     def next_consumer(self):
@@ -260,19 +261,26 @@ class FakeBroker:
         self._drain(q)
 
     def _drain(self, q: _BrokerQueue) -> None:
-        """Deliver pending messages FIFO under the broker lock — every
-        publish and consumer attach funnels through here, so a new publish
-        can never overtake an older backlog message."""
-        with self._lock:
-            while q.pending:
-                consumer = q.next_consumer()
-                if consumer is None:
-                    return
-                body = q.pending.popleft()
+        """Deliver pending messages FIFO. Every publish and consumer attach
+        funnels through here; the PER-QUEUE drain lock serializes drainers
+        (so a new publish can never overtake an older backlog message)
+        while the blocking socket send happens outside the broker-global
+        lock — one slow consumer must not stall every queue or deadlock
+        against a publisher blocked on its own send."""
+        with q.drain_lock:
+            while True:
+                with self._lock:
+                    if not q.pending:
+                        return
+                    consumer = q.next_consumer()
+                    if consumer is None:
+                        return
+                    body = q.pending.popleft()
                 try:
                     consumer.deliver(q.name, body)
                 except OSError:
-                    q.pending.appendleft(body)
+                    with self._lock:
+                        q.pending.appendleft(body)
                     return
 
     def _requeue_unacked(self, conn: _Connection) -> None:

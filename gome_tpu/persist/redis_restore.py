@@ -115,16 +115,15 @@ def discover_symbols(store) -> list[str]:
 def read_book(store, symbol: str):
     """-> (per-side lists of node dicts in priority order, pre-pool keys).
     Each node: {uuid, oid, price(int ticks), volume(int lots)}."""
+    depth_hash = {
+        _as_str(k): v for k, v in store.hgetall(f"{symbol}:depth").items()
+    }
     sides = []
     for side, zkey_sfx in ((0, "BUY"), (1, "SALE")):
         members = store.zrange(f"{symbol}:{zkey_sfx}", 0, -1)
         prices = sorted(
             (_ticks(m) for m in members), reverse=(side == BUY)
         )
-        depth_hash = {
-            _as_str(k): v
-            for k, v in store.hgetall(f"{symbol}:depth").items()
-        }
         slots = []
         for p in prices:
             link = store.hgetall(f"{symbol}:link:{p}")

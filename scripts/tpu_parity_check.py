@@ -18,7 +18,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def main():
+def run_parity(S=512, T=16, CAP=128, K=16, G=4, log=print) -> int:
+    """Compiled-kernel vs scan parity on the current (TPU) backend.
+    Returns 0 on exact equality of every leaf, 1 on mismatch, 2 on an
+    unblockable S. Importable — bench.py gates every TPU pallas bench on
+    this before reporting numbers."""
     import jax
     import jax.numpy as jnp
 
@@ -27,18 +31,16 @@ def main():
     from gome_tpu.ops import pallas_available, pallas_batch_step
 
     if jax.default_backend() != "tpu":
-        print("SKIP: no TPU backend (compiled-kernel parity needs one)")
+        log("SKIP: no TPU backend (compiled-kernel parity needs one)")
         return 0
     assert pallas_available(jnp.int32)
 
-    args = [int(a) for a in sys.argv[1:6]]
-    S, T, CAP, K, G = args + [512, 16, 128, 16, 4][len(args):]
     from gome_tpu.ops import default_block_s
 
     block_s = default_block_s(S)
     if block_s is None:
-        print(f"S={S} has no valid compiled-kernel blocking "
-              "(see gome_tpu.ops.default_block_s)")
+        log(f"S={S} has no valid compiled-kernel blocking "
+            "(see gome_tpu.ops.default_block_s)")
         return 2
     config = BookConfig(cap=CAP, max_fills=K, dtype=jnp.int32)
     rng = np.random.default_rng(7)
@@ -68,20 +70,26 @@ def main():
             b = np.asarray(jax.device_get(getattr(o_pall, name)))
             if not np.array_equal(a, b):
                 bad = np.argwhere(a != b)[:5]
-                print(f"MISMATCH grid {g} StepOutput.{name} at {bad}")
+                log(f"MISMATCH grid {g} StepOutput.{name} at {bad}")
                 return 1
         for name in b_scan._fields:
             a = np.asarray(jax.device_get(getattr(b_scan, name)))
             b = np.asarray(jax.device_get(getattr(b_pall, name)))
             if not np.array_equal(a, b):
                 bad = np.argwhere(a != b)[:5]
-                print(f"MISMATCH grid {g} BookState.{name} at {bad}")
+                log(f"MISMATCH grid {g} BookState.{name} at {bad}")
                 return 1
         fills = int(np.asarray(jax.device_get(o_scan.n_fills)).sum())
-        print(f"grid {g}: OK ({fills} fills)")
-    print(f"PARITY OK: compiled pallas == scan on {G} grids "
-          f"({S}x{T} ops each, cancels + markets included)")
+        log(f"grid {g}: OK ({fills} fills)")
+    log(f"PARITY OK: compiled pallas == scan on {G} grids "
+        f"({S}x{T} ops each, cancels + markets included)")
     return 0
+
+
+def main():
+    args = [int(a) for a in sys.argv[1:6]]
+    S, T, CAP, K, G = args + [512, 16, 128, 16, 4][len(args):]
+    return run_parity(S, T, CAP, K, G)
 
 
 if __name__ == "__main__":
