@@ -409,19 +409,33 @@ def _decode_compact(eng, meta, shape, fetched) -> dict:
     return {name: v[order] for name, v in columns.items()}
 
 
-def apply_frame_fast(eng: BatchEngine, cols: dict):
-    """Production hot path: dispatch every grid + compaction back-to-back
-    (no host sync between grids), resolve the whole frame with one
-    overlapped fetch, and fall back — transactionally — to the exact path
-    when any device budget tripped. Semantics identical to apply_frame."""
-    if eng.mesh is not None:
-        return apply_frame(eng, cols)
+class PendingFrame:
+    """A frame whose grids are dispatched (device side in flight) but not
+    yet resolved: everything resolve_frame needs, plus the checkpoint that
+    makes a tripped budget or failure transactionally recoverable."""
+
+    __slots__ = ("cols", "arrays", "checkpoint", "items")
+
+    def __init__(self, cols, arrays, checkpoint, items):
+        self.cols = cols
+        self.arrays = arrays
+        self.checkpoint = checkpoint
+        self.items = items  # [(meta, (t_grid, K), compact, n_ops)]
+
+
+def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
+    """Dispatch every grid of the frame + its device-side compaction
+    back-to-back (no host sync) and start the async device->host copies.
+    Advances eng.books — a later submit_frame builds on this frame's
+    result, so frames pipeline while preserving sequential semantics.
+    Raises (with rollback) only on host-side errors; device budget trips
+    surface at resolve_frame."""
     cp = eng._checkpoint()
     try:
         a = _frame_arrays(eng, cols)
         grids = pack_frame_grids(eng, a)
         books = eng.books
-        pending = []
+        items = []
         for ops, meta, lane_ids in grids:
             books, outs = eng._step(books, ops, lane_ids)
             eng.stats.device_calls += 1
@@ -432,38 +446,64 @@ def apply_frame_fast(eng: BatchEngine, cols: dict):
                 eng.config, outs, e_fills, e_cancels
             )
             meta["_n_rows"] = n_rows
-            pending.append(
+            items.append(
                 (meta, (t_grid, eng.config.max_fills), compact, n_ops)
             )
         eng.books = books
-        for _, _, compact, _ in pending:
+        for _, _, compact, _ in items:
             for leaf in jax.tree.leaves(compact):
                 leaf.copy_to_host_async()
-        batches = []
-        global FETCH_SECONDS
-        for meta, shape, compact, n_ops in pending:
-            t0 = time.perf_counter()
-            fetched = jax.device_get(compact)
-            FETCH_SECONDS += time.perf_counter() - t0
-            totals = fetched[0]
-            if (
-                int(totals[2]) > 0  # book overflow: state is wrong
-                or int(totals[3]) > eng.config.max_fills  # truncated records
-                or int(totals[0]) > len(fetched[1]["src"])  # buffer overflow
-                or int(totals[1]) > len(fetched[2]["src"])
-            ):
-                raise _NeedExact()
-            batches.append(_decode_compact(eng, meta, shape, fetched))
-        return _assemble(eng, a, batches)
-    except _NeedExact:
+        return PendingFrame(cols, a, cp, items)
+    except Exception:
         eng._restore(cp)
+        raise
+
+
+def resolve_frame(eng: BatchEngine, pend: PendingFrame):
+    """Fetch + decode a submitted frame. Raises _NeedExact when a device
+    budget tripped — the CALLER owns the recovery (rewind to
+    pend.checkpoint, exact-run, resubmit anything submitted after); the
+    single-frame wrapper apply_frame_fast and the pipelined executor
+    (engine.pipeline.FramePipeline) both do."""
+    batches = []
+    global FETCH_SECONDS
+    for meta, shape, compact, n_ops in pend.items:
+        t0 = time.perf_counter()
+        fetched = jax.device_get(compact)
+        FETCH_SECONDS += time.perf_counter() - t0
+        totals = fetched[0]
+        if (
+            int(totals[2]) > 0  # book overflow: state is wrong
+            or int(totals[3]) > eng.config.max_fills  # truncated records
+            or int(totals[0]) > len(fetched[1]["src"])  # buffer overflow
+            or int(totals[1]) > len(fetched[2]["src"])
+        ):
+            raise _NeedExact()
+        batches.append(_decode_compact(eng, meta, shape, fetched))
+    return _assemble(eng, pend.arrays, batches)
+
+
+def apply_frame_fast(eng: BatchEngine, cols: dict):
+    """Production hot path, single-frame form: submit + resolve with one
+    overlapped fetch; falls back — transactionally — to the exact path
+    when any device budget tripped. Semantics identical to apply_frame."""
+    if eng.mesh is not None:
+        return apply_frame(eng, cols)
+    try:
+        pend = submit_frame(eng, cols)
+    except Exception:
+        raise
+    try:
+        return resolve_frame(eng, pend)
+    except _NeedExact:
+        eng._restore(pend.checkpoint)
         try:
             return apply_frame(eng, cols)
         except Exception:
-            eng._restore(cp)
+            eng._restore(pend.checkpoint)
             raise
     except Exception:
-        eng._restore(cp)
+        eng._restore(pend.checkpoint)
         raise
 
 

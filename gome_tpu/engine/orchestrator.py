@@ -155,12 +155,25 @@ class MatchEngine:
         semantics identical to process() — unmarked ADDs drop, DELs clear
         their marks — applied by filtering the columns, then the
         zero-per-order-Python frame path (engine.frames) runs the batch.
-        Returns an EventBatch. fast=True uses the pipelined device-side
+        Returns an EventBatch. fast=True uses the device-side
         event-compaction path (one fetch per frame; transparently falls
-        back to the exact escalating path when a device budget trips)."""
-        import numpy as np
-
+        back to the exact escalating path when a device budget trips).
+        For cross-frame pipelining use engine.pipeline.FramePipeline."""
         from . import frames
+
+        cols, consumed = self.admit_frame(cols)
+        run = frames.apply_frame_fast if fast else frames.process_frame
+        try:
+            return run(self.batch, cols)
+        except Exception:
+            self.pre_pool |= consumed
+            raise
+
+    def admit_frame(self, cols: dict) -> tuple[dict, set]:
+        """Frame admission: returns (filtered columns, the pre-pool keys
+        consumed) — the caller restores `consumed` if the batch later
+        fails (at-least-once replay must not drop re-admitted ADDs)."""
+        import numpy as np
 
         n = int(cols["n"])
         action = cols["action"].tolist()
@@ -203,12 +216,7 @@ class MatchEngine:
                     )
                 },
             )
-        run = frames.apply_frame_fast if fast else frames.process_frame
-        try:
-            return run(self.batch, cols)
-        except Exception:
-            self.pre_pool |= consumed
-            raise
+        return cols, consumed
 
     @staticmethod
     def _prekey(order: Order) -> tuple[str, str, str]:

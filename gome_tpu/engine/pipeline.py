@@ -1,0 +1,108 @@
+"""Cross-frame pipelining: overlap frame k+1's host work (decode, intern,
+pack, dispatch) with frame k's device execution and device->host fetch.
+
+The single-frame fast path (frames.apply_frame_fast) already collapses a
+frame to one overlapped fetch, but a synchronous consumer still serializes
+[host k] -> [fetch k] -> [host k+1] -> ... . submit_frame advances
+eng.books at dispatch time, so a later frame can be SUBMITTED before an
+earlier one is RESOLVED — sequential matching semantics hold because the
+device executes the dispatched grids in order; only the host-side
+resolution (fetch + decode + publish) trails behind. Steady-state
+throughput becomes max(host_time, fetch_time) per frame instead of their
+sum.
+
+Recovery keeps the transactional story:
+
+  * a device budget tripped in frame k (detected at resolve): rewind the
+    engine to k's checkpoint, re-run k on the exact escalating path, then
+    RESUBMIT every later in-flight frame on top (their columns are
+    retained; their pre-pool admission is not repeated — the marks were
+    already consumed at feed time and stay consumed);
+  * a hard failure: rewind to k's checkpoint, restore every in-flight
+    frame's consumed pre-pool marks, clear the pipeline, re-raise — the
+    at-least-once consumer replays all of them from the uncommitted
+    offset.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from . import frames
+from .orchestrator import MatchEngine
+
+
+class FramePipeline:
+    """Depth-D pipelined ORDER-frame executor over one MatchEngine.
+
+    feed(cols, token) submits a frame (admission included) and returns any
+    frames that resolved as a list of (token, EventBatch); flush() drains
+    the rest. Tokens let the caller (the consumer) commit each frame's bus
+    offset only after ITS events resolved and published."""
+
+    def __init__(self, engine: MatchEngine, depth: int = 2):
+        if depth < 1:
+            raise ValueError("pipeline depth must be >= 1")
+        self.engine = engine
+        self.depth = depth
+        self._q: deque = deque()  # (pending, consumed, token)
+
+    def feed(self, cols: dict, token=None) -> list[tuple]:
+        eng = self.engine.batch
+        fcols, consumed = self.engine.admit_frame(cols)
+        try:
+            pend = frames.submit_frame(eng, fcols)
+        except Exception:
+            # submit rolled the engine back; this frame's marks restore
+            # here, in-flight frames are untouched (they precede it).
+            self.engine.pre_pool |= consumed
+            raise
+        self._q.append((pend, consumed, token))
+        out = []
+        while len(self._q) > self.depth:
+            out.append(self._resolve_oldest())
+        return out
+
+    def flush(self) -> list[tuple]:
+        out = []
+        while self._q:
+            out.append(self._resolve_oldest())
+        return out
+
+    def _resolve_oldest(self):
+        eng = self.engine.batch
+        pend, consumed, token = self._q.popleft()
+        try:
+            return (token, frames.resolve_frame(eng, pend))
+        except frames._NeedExact:
+            # Budget tripped: rewind THROUGH every later in-flight frame
+            # (they were submitted on top of the bad state), replay this
+            # frame exactly, then resubmit the later ones.
+            eng._restore(pend.checkpoint)
+            batch = frames.apply_frame(eng, pend.cols)
+            later = list(self._q)
+            self._q.clear()
+            try:
+                for lp, lc, lt in later:
+                    self._q.append(
+                        (frames.submit_frame(eng, lp.cols), lc, lt)
+                    )
+            except Exception:
+                # The failed resubmit rolled itself back; it and anything
+                # after it fall out of the pipeline — restore their marks
+                # so the consumer's replay re-admits them.
+                for _lp2, lc2, _lt2 in later[len(self._q) :]:
+                    self.engine.pre_pool |= lc2
+                raise
+            return (token, batch)
+        except Exception:
+            # Hard failure: no trace of this frame or anything after it.
+            eng._restore(pend.checkpoint)
+            self.engine.pre_pool |= consumed
+            for _lp, lc, _lt in self._q:
+                self.engine.pre_pool |= lc
+            self._q.clear()
+            raise
+
+    def __len__(self) -> int:
+        return len(self._q)

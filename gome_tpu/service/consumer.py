@@ -56,11 +56,19 @@ class OrderConsumer:
         on_batch=None,
         poison_threshold: int = 3,
         match_wire: str = "json",
+        pipeline_depth: int = 0,
     ):
         """match_wire: "json" publishes one reference-shape JSON document
         per event (rabbitmq.go wire parity); "frame" publishes one binary
         EVENT frame per batch (bus.colwire) — the high-throughput internal
-        transport (the feed decodes both)."""
+        transport (the feed decodes both).
+
+        pipeline_depth > 0 enables cross-frame pipelining for ORDER-frame
+        traffic (engine.pipeline.FramePipeline): up to that many frames
+        stay in flight on the device while the host packs the next, and a
+        frame's offset commits only once ITS events published. Requires a
+        MatchEngine (admit_frame); JSON messages still process
+        synchronously (the pipeline drains first, preserving order)."""
         if match_wire not in ("json", "frame"):
             raise ValueError(f"match_wire must be json|frame, got {match_wire}")
         self.engine = engine

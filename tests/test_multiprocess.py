@@ -61,6 +61,75 @@ def test_cross_process_file_bus_pipeline(tmp_path):
     engine.batch.verify_books()
 
 
+_AMQP_PRODUCER = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from gome_tpu.bus import encode_order
+from gome_tpu.bus.amqp import AmqpQueue
+from gome_tpu.utils.streams import doorder_stream
+
+q = AmqpQueue("doOrder", port={port})
+orders = list(doorder_stream(n=120))
+for o in orders:
+    q.publish(encode_order(o))
+q.close()
+print(len(orders))
+"""
+
+
+def test_cross_process_amqp_pipeline():
+    """The reference's ACTUAL topology: separate producer process speaking
+    AMQP 0-9-1 over TCP to the broker; this process consumes, matches, and
+    publishes MatchResults back over AMQP — the full rabbitmq.go story with
+    the fake broker standing in for RabbitMQ."""
+    from gome_tpu.bus import QueueBus
+    from gome_tpu.bus.amqp import AmqpQueue
+    from gome_tpu.bus.fakebroker import FakeBroker
+
+    broker = FakeBroker().start()
+    try:
+        out = subprocess.run(
+            [
+                sys.executable, "-c",
+                _AMQP_PRODUCER.format(repo=_REPO, port=broker.port),
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        n_published = int(out.stdout.strip())
+
+        orders = list(doorder_stream(n=120))
+        oracle = OracleEngine()
+        expected = []
+        for o in orders:
+            expected.extend(oracle.process(o))
+
+        bus = QueueBus(
+            AmqpQueue("doOrder", port=broker.port),
+            AmqpQueue("matchOrder", port=broker.port),
+        )
+        engine = MatchEngine(BookConfig(cap=64, max_fills=8), n_slots=4)
+        for o in orders:
+            engine.mark(o)
+        consumer = OrderConsumer(engine, bus, batch_n=64)
+        drained = 0
+        import time as _time
+
+        deadline = _time.monotonic() + 30
+        while drained < n_published and _time.monotonic() < deadline:
+            drained += consumer.run_once()
+        assert drained == n_published == len(orders)
+
+        msgs = bus.match_queue.read_from(0, 10_000)
+        events = [decode_match_result(m.body) for m in msgs]
+        assert events == expected
+        engine.batch.verify_books()
+        bus.order_queue.close()
+        bus.match_queue.close()
+    finally:
+        broker.stop()
+
+
 def test_verify_books_catches_corruption():
     import jax
     import numpy as np
