@@ -280,6 +280,7 @@ def service_main():
     FRAME = int(os.environ.get("SVC_FRAME", 2_048 if check else 262_144))
     S = int(os.environ.get("SVC_SYMBOLS", 64 if check else 10_240))
     CAP = int(os.environ.get("SVC_CAP", 32 if check else 256))
+    PIPE = int(os.environ.get("SVC_PIPELINE", 2))  # cross-frame pipelining
     engine = MatchEngine(
         config=BookConfig(cap=CAP, max_fills=16, dtype=jnp.int32),
         n_slots=S,
@@ -288,7 +289,8 @@ def service_main():
     )
     bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
     consumer = OrderConsumer(
-        engine, bus, batch_n=1, batch_wait_s=0, match_wire="frame"
+        engine, bus, batch_n=1, batch_wait_s=0, match_wire="frame",
+        pipeline_depth=PIPE,
     )
 
     rng = np.random.default_rng(7)

@@ -103,6 +103,10 @@ class EngineConfig:
     dtype: str = "int64"  # "int32" halves HBM traffic when ranges allow
     auto_grow: bool = True
     kernel: str = "scan"  # scan (XLA) | pallas (VMEM-resident TPU kernel)
+    # Cross-frame pipelining depth for ORDER-frame traffic (0 = synchronous;
+    # N > 0 keeps up to N frames in flight on the device while the host
+    # packs the next — engine.pipeline.FramePipeline).
+    pipeline_depth: int = 0
 
     def __post_init__(self):
         if not 0 <= self.accuracy <= 18:
@@ -111,6 +115,10 @@ class EngineConfig:
             v = getattr(self, name)
             if v <= 0:
                 raise ValueError(f"engine.{name} must be positive, got {v}")
+        if self.pipeline_depth < 0:
+            raise ValueError(
+                f"engine.pipeline_depth must be >= 0, got {self.pipeline_depth}"
+            )
         if self.dtype not in ("int32", "int64"):
             raise ValueError(f"engine.dtype must be int32|int64, got {self.dtype}")
         from .types import KERNELS

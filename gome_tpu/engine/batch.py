@@ -513,10 +513,19 @@ class BatchEngine:
         )
 
     def _restore(self, cp) -> None:
+        """Restore MUST copy the mutable arrays: a checkpoint may be
+        restored more than once (restore -> exact re-run mutates rebasing
+        state in place -> re-run fails -> restore the SAME checkpoint
+        again, e.g. FramePipeline's recovery); assigning by reference would
+        let the interim mutations corrupt the checkpoint itself."""
         (
             self.books, self.config, self.n_slots,
-            self.price_base, self._base_set, self._env_lo, self._env_hi,
+            price_base, base_set, env_lo, env_hi,
         ) = cp
+        self.price_base = price_base.copy()
+        self._base_set = base_set.copy()
+        self._env_lo = env_lo.copy()
+        self._env_hi = env_hi.copy()
 
     def process(self, orders: list[Order]) -> list[MatchResult]:
         """Apply a micro-batch. Symbols with more than max_t ops are drained

@@ -69,6 +69,29 @@ class FramePipeline:
             out.append(self._resolve_oldest())
         return out
 
+    def step(self):
+        """Resolve the oldest in-flight frame, or None if nothing is in
+        flight — the consumer's make-progress primitive when the order
+        queue is momentarily empty."""
+        if not self._q:
+            return None
+        return self._resolve_oldest()
+
+    def abort(self) -> None:
+        """Discard every in-flight frame: rewind the engine to the oldest
+        frame's checkpoint and restore all consumed pre-pool marks, so the
+        at-least-once consumer can replay from its uncommitted offset. Used
+        when a failure OUTSIDE the pipeline (e.g. the match-queue publish of
+        an already-resolved frame) forces the consumer to restart a span
+        whose later frames are still in flight."""
+        if not self._q:
+            return
+        eng = self.engine.batch
+        eng._restore(self._q[0][0].checkpoint)
+        for _pend, consumed, _token in self._q:
+            self.engine.pre_pool |= consumed
+        self._q.clear()
+
     def _resolve_oldest(self):
         eng = self.engine.batch
         pend, consumed, token = self._q.popleft()
@@ -79,20 +102,41 @@ class FramePipeline:
             # (they were submitted on top of the bad state), replay this
             # frame exactly, then resubmit the later ones.
             eng._restore(pend.checkpoint)
-            batch = frames.apply_frame(eng, pend.cols)
             later = list(self._q)
             self._q.clear()
+            try:
+                batch = frames.apply_frame(eng, pend.cols)
+            except Exception:
+                # The exact re-run itself failed (e.g. the overflow that
+                # tripped the budget exceeds max_cap). _run_exact commits
+                # books per grid, so partial state may be applied: rewind
+                # to the checkpoint and restore this frame's AND every
+                # later in-flight frame's consumed pre-pool marks — the
+                # at-least-once consumer replays all of them from the
+                # uncommitted offset (mirrors apply_frame_fast's fallback).
+                eng._restore(pend.checkpoint)
+                self.engine.pre_pool |= consumed
+                for _lp, lc, _lt in later:
+                    self.engine.pre_pool |= lc
+                raise
             try:
                 for lp, lc, lt in later:
                     self._q.append(
                         (frames.submit_frame(eng, lp.cols), lc, lt)
                     )
             except Exception:
-                # The failed resubmit rolled itself back; it and anything
-                # after it fall out of the pipeline — restore their marks
-                # so the consumer's replay re-admits them.
-                for _lp2, lc2, _lt2 in later[len(self._q) :]:
+                # A resubmit failed AFTER the exact re-run committed this
+                # frame. Returning nothing would lose the frame's events
+                # (its marks are consumed, so the replay would drop its
+                # ADDs): treat the whole span as a hard failure instead —
+                # rewind THROUGH the exact re-run to this frame's
+                # checkpoint, restore its and every later frame's marks,
+                # and let the at-least-once replay regenerate everything.
+                eng._restore(pend.checkpoint)
+                self.engine.pre_pool |= consumed
+                for _lp2, lc2, _lt2 in later:
                     self.engine.pre_pool |= lc2
+                self._q.clear()
                 raise
             return (token, batch)
         except Exception:

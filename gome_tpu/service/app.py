@@ -49,6 +49,7 @@ class EngineService:
             batch_n=e.max_t * max(1, e.n_slots // 8),
             on_batch=on_batch,
             match_wire=self.config.bus.match_wire,
+            pipeline_depth=e.pipeline_depth,
         )
         from ..engine.step import LOT_MAX32
 

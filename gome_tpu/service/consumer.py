@@ -71,11 +71,24 @@ class OrderConsumer:
         synchronously (the pipeline drains first, preserving order)."""
         if match_wire not in ("json", "frame"):
             raise ValueError(f"match_wire must be json|frame, got {match_wire}")
+        if pipeline_depth < 0:
+            raise ValueError("pipeline_depth must be >= 0")
+        if pipeline_depth > 0 and not hasattr(engine, "admit_frame"):
+            raise ValueError(
+                "pipeline_depth requires a MatchEngine (admit_frame); the "
+                f"given engine {type(engine).__name__} has no frame pipeline"
+            )
         self.engine = engine
         self.bus = bus
         self.match_wire = match_wire
         self.batch_n = batch_n
         self.batch_wait_s = batch_wait_s
+        self.pipeline_depth = pipeline_depth
+        self._pipe = None  # lazily-built FramePipeline (pipeline_depth > 0)
+        # Persist-hook counts deferred to the next pipeline-empty boundary
+        # (on_batch must only observe consistent cuts; see _emit_resolved).
+        self._hook_orders = 0
+        self._hook_events = 0
         self.on_batch = on_batch  # callback(n_orders, n_events): persist hook
         # Poison-batch policy: a deterministic per-batch error (e.g. a lane
         # CapacityError) would otherwise replay the same uncommitted offset
@@ -104,6 +117,8 @@ class OrderConsumer:
 
     def run_once(self) -> int:
         """Drain one micro-batch; returns the number of orders processed."""
+        if self.pipeline_depth > 0:
+            return self._run_once_pipelined()
         msgs = self.bus.order_queue.poll_batch(self.batch_n, self.batch_wait_s)
         if not msgs:
             return 0
@@ -122,26 +137,15 @@ class OrderConsumer:
                         cols = decode_order_frame(msgs[i].body)
                         batch = self.engine.process_frame(cols)
                         count = int(cols["n"])
+                    with annotate("publish_events"):
+                        self._publish(batch)
+                    n_orders += count
+                    n_events += len(batch)
                     i += 1
                 else:
-                    j = i
-                    while j < len(msgs) and not is_frame(msgs[j].body):
-                        j += 1
-                    with annotate("decode_orders"):
-                        orders = decode_orders_batch(
-                            [m.body for m in msgs[i:j]]
-                        )
-                    with annotate("engine_process"):
-                        # Columnar path end to end: events stay as numpy
-                        # columns from decode through wire serialization;
-                        # no per-event Python objects on the hot path.
-                        batch = self.engine.process_columnar(orders)
-                    count = len(orders)
-                    i = j
-                with annotate("publish_events"):
-                    self._publish(batch)
-                n_orders += count
-                n_events += len(batch)
+                    i, n_o, n_e = self._process_json_run(msgs, i)
+                    n_orders += n_o
+                    n_events += n_e
             # Commit only after results are published: a crash between
             # processing and commit replays the batch (at-least-once;
             # recovery dedup lives in gome_tpu.persist's replay logic).
@@ -154,6 +158,131 @@ class OrderConsumer:
             _throughput.set(0.8 * _throughput.value() + 0.2 * inst)
         if self.on_batch is not None:
             self.on_batch(n_orders, n_events)
+        return n_orders
+
+    def _process_json_run(self, msgs, i: int) -> tuple[int, int, int]:
+        """Decode + process + publish one contiguous run of JSON messages
+        starting at msgs[i]; returns (j, n_orders, n_events) with j the
+        first index past the run. The CALLER commits — commit policy
+        differs between the synchronous and pipelined paths. Columnar path
+        end to end: events stay as numpy columns from decode through wire
+        serialization; no per-event Python objects on the hot path."""
+        from ..bus.colwire import is_frame
+
+        j = i
+        while j < len(msgs) and not is_frame(msgs[j].body):
+            j += 1
+        with annotate("decode_orders"):
+            orders = decode_orders_batch([m.body for m in msgs[i:j]])
+        with annotate("engine_process"):
+            batch = self.engine.process_columnar(orders)
+        with annotate("publish_events"):
+            self._publish(batch)
+        return j, len(orders), len(batch)
+
+    def _emit_resolved(self, token, batch) -> int:
+        """Publish one resolved frame's events and commit ITS offset —
+        frames resolve in FIFO order, so commits stay monotonic. The
+        persist hook (on_batch) is NOT called here: with frames in flight
+        the books are AHEAD of the committed offset, so a snapshot taken
+        now would double-apply the in-flight span on recovery; the counts
+        accumulate and the hook fires at the next pipeline-empty boundary
+        (a consistent cut)."""
+        offset, n = token
+        with annotate("publish_events"):
+            self._publish(batch)
+        self.bus.order_queue.commit(offset + 1)
+        self._account(n, len(batch))
+        return n
+
+    def _account(self, n_orders: int, n_events: int) -> None:
+        """Bookkeeping for one processed-and-committed unit in pipelined
+        mode: metrics now, persist hook deferred to the next consistent
+        cut."""
+        _orders_total.inc(n_orders)
+        _events_total.inc(n_events)
+        _batch_size.observe(n_orders)
+        self._hook_orders += n_orders
+        self._hook_events += n_events
+
+    def _run_once_pipelined(self) -> int:
+        """One consumer step with cross-frame pipelining: ORDER frames are
+        SUBMITTED to the device (host pack only) and a frame's offset
+        commits when it RESOLVES (fetch + decode) and its events publish —
+        up to pipeline_depth frames stay in flight, so frame k+1's host
+        work overlaps frame k's device execution + fetch. Non-frame (JSON)
+        runs drain the pipeline first (one frame at a time — a publish
+        failure loses at most one frame's events), then batch-decode as in
+        run_once. Any failure aborts the in-flight span (books rewound,
+        pre-pool marks restored) and re-raises — the at-least-once replay
+        from the uncommitted offset re-feeds it."""
+        from ..bus.colwire import decode_order_frame, is_frame
+        from ..engine.pipeline import FramePipeline
+
+        q = self.bus.order_queue
+        if self._pipe is None:
+            self._pipe = FramePipeline(self.engine, depth=self.pipeline_depth)
+        pipe = self._pipe
+        n_orders = 0
+        try:
+            if len(pipe) == 0:
+                msgs = q.poll_batch(self.batch_n, self.batch_wait_s)
+                if not msgs:
+                    return 0
+            else:
+                # Read cursor: committed offset + one message per in-flight
+                # frame (only whole ORDER-frame messages stay in flight).
+                msgs = q.read_from(q.committed() + len(pipe), self.batch_n)
+            with _batch_latency.time() as timer:
+                if not msgs:
+                    # Queue idle: make progress on the in-flight span.
+                    out = pipe.step()
+                    if out is not None:
+                        n_orders += self._emit_resolved(*out)
+                i = 0
+                while i < len(msgs):
+                    m = msgs[i]
+                    if is_frame(m.body):
+                        cols = decode_order_frame(m.body)
+                        with annotate("pipeline_feed"):
+                            resolved = pipe.feed(
+                                cols, token=(m.offset, int(cols["n"]))
+                            )
+                        for token, batch in resolved:
+                            n_orders += self._emit_resolved(token, batch)
+                        i += 1
+                    else:
+                        while True:  # drain in-flight, emit-as-resolved
+                            out = pipe.step()
+                            if out is None:
+                                break
+                            n_orders += self._emit_resolved(*out)
+                        j, n_o, n_e = self._process_json_run(msgs, i)
+                        q.commit(msgs[j - 1].offset + 1)
+                        n_orders += n_o
+                        self._account(n_o, n_e)
+                        i = j
+        except Exception:
+            # feed/resolve already restored their own frames' state; abort
+            # rewinds whatever is STILL in flight (a failed queue READ
+            # included — frames must never outlive a poison-policy
+            # quarantine) so the replay from the committed offset sees a
+            # consistent engine.
+            pipe.abort()
+            raise
+        if n_orders and timer.elapsed > 0:
+            inst = n_orders / timer.elapsed
+            _throughput.set(0.8 * _throughput.value() + 0.2 * inst)
+        if (
+            len(pipe) == 0
+            and self.on_batch is not None
+            and (self._hook_orders or self._hook_events)
+        ):
+            # Consistent cut: books correspond exactly to the committed
+            # offset only when nothing is in flight — the persist hook
+            # (snapshot cadence) must only observe such states.
+            self.on_batch(self._hook_orders, self._hook_events)
+            self._hook_orders = self._hook_events = 0
         return n_orders
 
     def drain(self) -> int:
@@ -196,6 +325,12 @@ class OrderConsumer:
                     self._fail_offset, self._fail_count = offset, 1
                 if self._fail_count >= self.poison_threshold:
                     self._fail_count = 0
+                    # Quarantine replays order-by-order from the committed
+                    # offset: anything still in flight in the pipeline
+                    # would be double-applied — abort it first (books
+                    # rewound, marks restored).
+                    if self._pipe is not None:
+                        self._pipe.abort()
                     return self.quarantine_once()
             except Exception:
                 log.exception("poison-batch policy step failed; will retry")

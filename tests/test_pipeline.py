@@ -1,0 +1,324 @@
+"""Cross-frame pipelining (engine.pipeline.FramePipeline + the consumer's
+pipeline_depth): the pipelined executor must produce the IDENTICAL event
+stream and book state as the synchronous frame path, including through
+budget escalations mid-pipeline, hard failures (at-least-once replay with
+pre-pool-mark restoration), and publish failures of resolved frames."""
+
+import numpy as np
+import pytest
+
+from gome_tpu.bus import MemoryQueue, QueueBus
+from gome_tpu.engine import frames as engine_frames
+from gome_tpu.engine.book import BookConfig
+from gome_tpu.engine.orchestrator import MatchEngine
+from gome_tpu.engine.pipeline import FramePipeline
+from gome_tpu.oracle import OracleEngine
+from gome_tpu.service.consumer import OrderConsumer
+from gome_tpu.types import Action, Order, Side
+from gome_tpu.utils.streams import multi_symbol_stream
+
+from test_frames import orders_to_frame
+
+
+def _frames_for(orders, chunk):
+    from gome_tpu.bus import colwire
+
+    payloads = []
+    for i in range(0, len(orders), chunk):
+        payloads.append(orders_to_frame(orders[i : i + chunk]))
+        assert colwire.is_frame(payloads[-1])
+    return payloads
+
+
+def _make(engine_kw, depth):
+    engine = MatchEngine(**engine_kw)
+    bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    consumer = OrderConsumer(
+        engine, bus, batch_n=4, batch_wait_s=0, match_wire="json",
+        pipeline_depth=depth,
+    )
+    return engine, bus, consumer
+
+
+def _run(engine_kw, orders, chunk, depth):
+    engine, bus, consumer = _make(engine_kw, depth)
+    for o in orders:
+        engine.mark(o)
+    for p in _frames_for(orders, chunk):
+        bus.order_queue.publish(p)
+    n = consumer.drain()
+    msgs = bus.match_queue.read_from(0, 1 << 20)
+    return engine, n, [m.body for m in msgs]
+
+
+def _assert_books_equal(a: MatchEngine, b: MatchEngine):
+    ba, bb = a.batch.lane_books(), b.batch.lane_books()
+    for name in ("price", "lots", "seq", "count", "next_seq"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ba, name)), np.asarray(getattr(bb, name))
+        )
+    assert a.pre_pool == b.pre_pool
+
+
+def _oracle_lines(orders):
+    from gome_tpu.bus import encode_match_result
+
+    oracle = OracleEngine()
+    out = []
+    for o in orders:
+        out.extend(encode_match_result(r) for r in oracle.process(o))
+    return out
+
+
+ENGINE_KW = dict(
+    config=BookConfig(cap=32, max_fills=8), n_slots=16, max_t=8
+)
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_pipelined_consumer_matches_synchronous(depth):
+    orders = multi_symbol_stream(n=300, n_symbols=5, seed=11, cancel_prob=0.2)
+    sync_eng, n_sync, sync_events = _run(ENGINE_KW, orders, 40, 0)
+    pipe_eng, n_pipe, pipe_events = _run(ENGINE_KW, orders, 40, depth)
+    assert n_pipe == n_sync == len(orders)
+    assert pipe_events == sync_events == _oracle_lines(orders)
+    _assert_books_equal(pipe_eng, sync_eng)
+    pipe_eng.batch.verify_books()
+
+
+def test_pipelined_escalation_mid_pipeline():
+    """A frame in the middle of the in-flight span trips device budgets
+    (book overflow + record truncation): the pipeline must rewind, re-run
+    exactly, resubmit the later frames, and still match the oracle."""
+    orders = [
+        Order(uuid="u", oid=str(i), symbol="s", side=Side.SALE,
+              price=100 + i, volume=1)
+        for i in range(40)  # overflows cap=8
+    ]
+    orders.append(
+        Order(uuid="u", oid="sweep", symbol="s", side=Side.BUY, price=300,
+              volume=1000)  # 40 fills > max_fills=4
+    )
+    orders += [
+        Order(uuid="u", oid=f"post{i}", symbol="s2",
+              side=Side(int(i % 2)), price=200 + (i % 3), volume=2)
+        for i in range(30)
+    ]
+    kw = dict(config=BookConfig(cap=8, max_fills=4), n_slots=8, max_t=4)
+    sync_eng, _, sync_events = _run(kw, orders, 10, 0)
+    pipe_eng, _, pipe_events = _run(kw, orders, 10, 3)
+    assert pipe_events == sync_events == _oracle_lines(orders)
+    assert pipe_eng.stats.cap_escalations >= 1
+    _assert_books_equal(pipe_eng, sync_eng)
+    pipe_eng.batch.verify_books()
+
+
+def test_pipeline_hard_failure_restores_marks_and_replays(monkeypatch):
+    """A hard failure at resolve time must leave no trace: books rewound to
+    the failed frame's checkpoint, its and every later in-flight frame's
+    pre-pool marks restored — so the consumer's at-least-once replay from
+    the uncommitted offset converges to the synchronous result."""
+    orders = multi_symbol_stream(n=200, n_symbols=4, seed=3, cancel_prob=0.15)
+    sync_eng, _, sync_events = _run(ENGINE_KW, orders, 25, 0)
+
+    engine, bus, consumer = _make(ENGINE_KW, 2)
+    for o in orders:
+        engine.mark(o)
+    for p in _frames_for(orders, 25):
+        bus.order_queue.publish(p)
+
+    real = engine_frames.resolve_frame
+    fail = {"left": 2}
+
+    def flaky(eng, pend):
+        if fail["left"] > 0:
+            fail["left"] -= 1
+            raise RuntimeError("injected resolve failure")
+        return real(eng, pend)
+
+    monkeypatch.setattr(engine_frames, "resolve_frame", flaky)
+    total = 0
+    end = bus.order_queue.end_offset()
+    for _ in range(200):
+        total += consumer.step_with_policy()
+        if bus.order_queue.committed() >= end:
+            break
+    assert bus.order_queue.committed() == end
+    assert total == len(orders)
+    msgs = bus.match_queue.read_from(0, 1 << 20)
+    assert [m.body for m in msgs] == sync_events
+    _assert_books_equal(engine, sync_eng)
+    engine.batch.verify_books()
+
+
+def test_pipeline_submit_failure_restores_own_marks(monkeypatch):
+    """feed() failing at submit must restore THAT frame's consumed marks and
+    leave earlier in-flight frames untouched."""
+    orders = multi_symbol_stream(n=60, n_symbols=3, seed=7, cancel_prob=0.1)
+    engine = MatchEngine(**ENGINE_KW)
+    for o in orders:
+        engine.mark(o)
+    pipe = FramePipeline(engine, depth=4)
+    from gome_tpu.bus import colwire
+
+    payloads = _frames_for(orders, 20)
+    cols0 = colwire.decode_order_frame(payloads[0])
+    pipe.feed(cols0, token=0)
+    marks_after_first = set(engine.pre_pool)
+
+    def boom(eng, cols):
+        raise RuntimeError("injected submit failure")
+
+    monkeypatch.setattr(engine_frames, "submit_frame", boom)
+    cols1 = colwire.decode_order_frame(payloads[1])
+    with pytest.raises(RuntimeError):
+        pipe.feed(cols1, token=1)
+    # Frame 1's marks restored; frame 0 still in flight with its marks
+    # consumed.
+    assert engine.pre_pool == marks_after_first
+    assert len(pipe) == 1
+
+
+def test_pipeline_abort_restores_in_flight_span():
+    orders = multi_symbol_stream(n=80, n_symbols=3, seed=9, cancel_prob=0.1)
+    engine = MatchEngine(**ENGINE_KW)
+    for o in orders:
+        engine.mark(o)
+    marks0 = set(engine.pre_pool)
+    pipe = FramePipeline(engine, depth=8)
+    from gome_tpu.bus import colwire
+
+    for i, p in enumerate(_frames_for(orders, 20)):
+        pipe.feed(colwire.decode_order_frame(p), token=i)
+    assert len(pipe) == 4
+    pipe.abort()
+    assert len(pipe) == 0
+    assert engine.pre_pool == marks0
+    ref = MatchEngine(**ENGINE_KW)
+    for o in orders:
+        ref.mark(o)
+    _assert_books_equal(engine, ref)
+
+
+def test_pipelined_publish_failure_aborts_and_replays():
+    """The match queue failing while a resolved frame publishes must not
+    wedge the consumer: the in-flight span aborts (marks restored) and the
+    replay converges. Events of the frame whose publish failed are lost —
+    the same window the synchronous path has (publish-after-process)."""
+
+    class FlakyQueue(MemoryQueue):
+        def __init__(self, name):
+            super().__init__(name)
+            self.fail_left = 1
+
+        def publish_batch(self, bodies):
+            if self.fail_left > 0 and bodies:
+                self.fail_left -= 1
+                raise RuntimeError("injected publish failure")
+            return super().publish_batch(bodies)
+
+    orders = multi_symbol_stream(n=150, n_symbols=4, seed=5, cancel_prob=0.1)
+    engine = MatchEngine(**ENGINE_KW)
+    bus = QueueBus(MemoryQueue("doOrder"), FlakyQueue("matchOrder"))
+    consumer = OrderConsumer(
+        engine, bus, batch_n=4, batch_wait_s=0, match_wire="json",
+        pipeline_depth=2,
+    )
+    for o in orders:
+        engine.mark(o)
+    for p in _frames_for(orders, 30):
+        bus.order_queue.publish(p)
+    end = bus.order_queue.end_offset()
+    for _ in range(200):
+        consumer.step_with_policy()
+        if bus.order_queue.committed() >= end:
+            break
+    assert bus.order_queue.committed() == end
+    engine.batch.verify_books()
+    # Books equal the synchronous end state (the failed frame WAS applied;
+    # only its events were lost to the failed publish).
+    sync_eng, _, _ = _run(ENGINE_KW, orders, 30, 0)
+    ba, bb = engine.batch.lane_books(), sync_eng.batch.lane_books()
+    for name in ("price", "lots", "count"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ba, name)), np.asarray(getattr(bb, name))
+        )
+
+
+def test_checkpoint_restorable_twice_after_interim_mutation():
+    """FramePipeline's recovery restores the SAME checkpoint twice with an
+    exact re-run mutating host rebasing state in between — the second
+    restore must return the pristine snapshot, not the interim mutations
+    (i.e. _restore must copy, never alias, the mutable arrays)."""
+    import jax.numpy as jnp
+
+    from gome_tpu.engine import BatchEngine
+
+    BTC = 10_000_000_000_000
+    eng = BatchEngine(
+        BookConfig(cap=8, max_fills=4, dtype=jnp.int32), n_slots=4, max_t=4
+    )
+    cp = eng._checkpoint()
+    base0 = eng.price_base.copy()
+    set0 = eng._base_set.copy()
+    eng._restore(cp)
+    # Interim work (the exact re-run) rebases a lane in place.
+    eng.process([
+        Order(uuid="u", oid="1", symbol="btc", side=Side.BUY, price=BTC,
+              volume=5)
+    ])
+    assert eng._base_set.any()
+    eng._restore(cp)  # second restore of the SAME checkpoint
+    np.testing.assert_array_equal(eng.price_base, base0)
+    np.testing.assert_array_equal(eng._base_set, set0)
+
+
+def test_pipelined_persist_hook_fires_only_at_consistent_cuts():
+    """on_batch (the persist snapshot hook) must only observe states where
+    the books correspond exactly to the committed offset — i.e. no frames
+    in flight; counts accumulate across the in-flight span."""
+    orders = multi_symbol_stream(n=200, n_symbols=4, seed=17, cancel_prob=0.1)
+    engine = MatchEngine(**ENGINE_KW)
+    bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    calls = []
+    consumer = OrderConsumer(
+        engine, bus, batch_n=4, batch_wait_s=0, match_wire="json",
+        pipeline_depth=2,
+        on_batch=lambda n, e: calls.append(
+            (n, e, len(consumer._pipe) if consumer._pipe else 0)
+        ),
+    )
+    for o in orders:
+        engine.mark(o)
+    for p in _frames_for(orders, 25):
+        bus.order_queue.publish(p)
+    n = consumer.drain()
+    assert n == len(orders)
+    assert sum(c[0] for c in calls) == len(orders)
+    assert all(c[2] == 0 for c in calls), calls
+
+
+def test_pipeline_mixed_json_and_frames():
+    """JSON messages interleaved with ORDER frames drain the pipeline first
+    — global order preserved."""
+    from gome_tpu.bus import encode_order
+
+    orders = multi_symbol_stream(n=120, n_symbols=4, seed=13, cancel_prob=0.15)
+    sync_eng, _, sync_events = _run(ENGINE_KW, orders, 24, 0)
+
+    engine, bus, consumer = _make(ENGINE_KW, 2)
+    for o in orders:
+        engine.mark(o)
+    # Frames for the first 96 orders, JSON for the rest, then one more frame.
+    head, mid, tail = orders[:72], orders[72:96], orders[96:]
+    for p in _frames_for(head, 24):
+        bus.order_queue.publish(p)
+    for o in mid:
+        bus.order_queue.publish(encode_order(o))
+    for p in _frames_for(tail, 24):
+        bus.order_queue.publish(p)
+    n = consumer.drain()
+    assert n == len(orders)
+    msgs = bus.match_queue.read_from(0, 1 << 20)
+    assert [m.body for m in msgs] == sync_events
+    _assert_books_equal(engine, sync_eng)
