@@ -25,6 +25,7 @@ from __future__ import annotations
 from ..types import Action, MatchResult, Order
 from .batch import BatchEngine, EngineStats
 from .book import BookConfig
+from .prepool import LocalPrePool, consume_batch_of
 
 
 class MatchEngine:
@@ -51,7 +52,11 @@ class MatchEngine:
             auto_grow=auto_grow,
             kernel=kernel,
         )
-        self.pre_pool: set[tuple[str, str, str]] = set()
+        # The marker store shared with the gateway. In-process by default;
+        # split-process deployments assign a prepool.RespPrePool here (and
+        # in the gateway process) so the markers live in a Redis-compatible
+        # server exactly as the reference's do (nodepool.go:14-28).
+        self.pre_pool = LocalPrePool()
 
     # -- gateway side ------------------------------------------------------
     def mark(self, order: Order) -> None:
@@ -114,25 +119,26 @@ class MatchEngine:
         (process/_columnar do) — the at-least-once consumer replays failed
         batches, and a replayed ADD must not die as unmarked just because
         the failed attempt already popped its key."""
+        sel: list[tuple[int, Order]] = []
+        keys: list[tuple[str, str, str]] = []
+        for item in indexed:
+            action = item[1].action
+            if action is Action.ADD or action is Action.DEL:
+                sel.append(item)
+                keys.append(self._prekey(item[1]))
+            # NOP padding never reaches the device.
+        existed = consume_batch_of(self.pre_pool, keys)
         admitted: list[tuple[int, Order]] = []
         consumed: set[tuple[str, str, str]] = set()
-        for item in indexed:
-            order = item[1]
-            if order.action is Action.ADD:
-                key = self._prekey(order)
-                if key not in self.pre_pool:
+        for item, key, ex in zip(sel, keys, existed):
+            if item[1].action is Action.ADD:
+                if not ex:
                     self.stats.dropped_no_prepool += 1
                     continue
-                self.pre_pool.discard(key)
                 consumed.add(key)
-                admitted.append(item)
-            elif order.action is Action.DEL:
-                key = self._prekey(order)
-                if key in self.pre_pool:
-                    self.pre_pool.discard(key)
-                    consumed.add(key)
-                admitted.append(item)
-            # NOP padding never reaches the device.
+            elif ex:
+                consumed.add(key)
+            admitted.append(item)
         return admitted, consumed
 
     # -- views -------------------------------------------------------------
@@ -180,30 +186,34 @@ class MatchEngine:
         syms, uuids = cols["symbols"], cols["uuids"]
         sidx, uidx = cols["symbol_idx"].tolist(), cols["uuid_idx"].tolist()
         oid_list = [o.decode() for o in cols["oids"].tolist()]
-        keep = np.ones(n, bool)
         consumed: set[tuple[str, str, str]] = set()
-        pool = self.pre_pool
         ADD, DEL = int(Action.ADD), int(Action.DEL)
         # Key construction at C speed: list-comp indexing + zip tuples;
         # symbol/uuid string objects are shared (hashes cached), only the
-        # oid hash is fresh per order.
+        # oid hash is fresh per order. Marks consume through ONE batched
+        # call — a single pipelined round trip when the pool is remote.
         keys = list(
             zip((syms[k] for k in sidx), (uuids[k] for k in uidx), oid_list)
         )
-        for i, (a, key) in enumerate(zip(action, keys)):
-            if a == ADD:
-                if key not in pool:
-                    keep[i] = False
-                    self.stats.dropped_no_prepool += 1
-                    continue
-                pool.discard(key)
-                consumed.add(key)
-            elif a == DEL:
-                if key in pool:
-                    pool.discard(key)
-                    consumed.add(key)
-            else:  # NOP padding never reaches the device
-                keep[i] = False
+        sel = [i for i, a in enumerate(action) if a == ADD or a == DEL]
+        existed = consume_batch_of(
+            self.pre_pool,
+            keys if len(sel) == n else [keys[i] for i in sel],
+        )
+        keep = np.zeros(n, bool)  # NOP padding never reaches the device
+        dropped = 0
+        for i, ex in zip(sel, existed):
+            if action[i] == ADD:
+                if ex:
+                    keep[i] = True
+                    consumed.add(keys[i])
+                else:
+                    dropped += 1
+            else:  # DEL: always admitted; a consumed mark kills a queued ADD
+                keep[i] = True
+                if ex:
+                    consumed.add(keys[i])
+        self.stats.dropped_no_prepool += dropped
         if not keep.all():
             cols = dict(
                 cols,

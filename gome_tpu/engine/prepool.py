@@ -1,0 +1,155 @@
+"""Pre-pool marker stores — the shared state between gateway and consumer.
+
+The reference keeps the pre-pool in Redis so its three processes agree on
+which ADDs are still live: the gateway marks at accept
+(main.go:44-45 -> nodepool.go:14-16, HSET S:comparison S:U:O 1), the
+consumer consumes the mark at SetOrder (engine.go:58-62, exists+delete)
+and a cancel clears it first (engine.go:88-90) — that is what makes the
+cancel-before-consume race drop the queued ADD (SURVEY §2.3.3).
+
+Two implementations of one contract:
+
+  LocalPrePool — a set subclass; single-process deployments (gateway and
+      consumer sharing the MatchEngine) need nothing more.
+  RespPrePool  — the markers live in a Redis-compatible server via the
+      dependency-free RESP client (persist.resp), under the reference's
+      EXACT schema, so (a) split-process topologies get reference
+      semantics, and (b) a live gome deployment's S:comparison hashes are
+      directly this pool's state during migration.
+
+The contract the engine uses (beyond set-ish add/discard/contains/iter):
+
+  consume_batch(keys) -> list[bool]   pop each (symbol, uuid, oid) key in
+      order; True where the key existed. Admission consumes marks through
+      this — ONE pipelined round trip per frame for the RESP pool instead
+      of 2 RTTs per order (the reference's exists+delete pair collapses to
+      HDEL's return value, same observable semantics single-consumer).
+"""
+
+from __future__ import annotations
+
+from ..types import Action
+
+Key = tuple[str, str, str]  # (symbol, uuid, oid) — S:U:O, ordernode.go:89-92
+
+
+class LocalPrePool(set):
+    """In-process marker store: a plain set of (symbol, uuid, oid)."""
+
+    def consume_batch(self, keys: list[Key]) -> list[bool]:
+        out = []
+        discard = self.discard
+        for k in keys:
+            if k in self:
+                discard(k)
+                out.append(True)
+            else:
+                out.append(False)
+        return out
+
+
+def consume_batch_of(pool, keys: list[Key]) -> list[bool]:
+    """consume_batch for any pool object — uses the pool's own batched
+    implementation when present, else the generic set-protocol fallback
+    (covers plain sets assigned by older persistence snapshots)."""
+    consume = getattr(pool, "consume_batch", None)
+    if consume is not None:
+        return consume(keys)
+    return LocalPrePool.consume_batch(pool, keys)  # set-protocol fallback
+
+
+class RespPrePool:
+    """Markers in a Redis-compatible server, reference schema:
+    hash `S:comparison`, field `S:U:O`, value "1" (nodepool.go:14-28).
+
+    Implements enough of the set protocol for the engine's rollback
+    (`pool |= consumed`), the persistence layer's snapshot (iteration) and
+    restore (clear/update), plus the batched consume the admission hot
+    path uses."""
+
+    def __init__(self, client):
+        self.client = client  # persist.resp.RespClient (or redis-py)
+
+    # -- schema ------------------------------------------------------------
+    @staticmethod
+    def _loc(key: Key) -> tuple[str, str]:
+        symbol, uuid, oid = key
+        return f"{symbol}:comparison", f"{symbol}:{uuid}:{oid}"
+
+    # -- set protocol ------------------------------------------------------
+    def add(self, key: Key) -> None:
+        k, f = self._loc(key)
+        self.client.execute_command("HSET", k, f, "1")
+
+    def discard(self, key: Key) -> None:
+        k, f = self._loc(key)
+        self.client.execute_command("HDEL", k, f)
+
+    def __contains__(self, key: Key) -> bool:
+        k, f = self._loc(key)
+        return self.client.execute_command("HEXISTS", k, f) == 1
+
+    def __ior__(self, keys):
+        cmds = []
+        for key in keys:
+            k, f = self._loc(key)
+            cmds.append(("HSET", k, f, "1"))
+        if cmds:
+            self._check(self.client.pipeline(cmds))
+        return self
+
+    def update(self, keys) -> None:
+        self.__ior__(keys)
+
+    def __iter__(self):
+        for hkey in self.client.keys("*:comparison"):
+            symbol = hkey[: -len(":comparison")]
+            for field in self.client.hgetall(hkey):
+                rest = field[len(symbol) + 1 :]  # strip "S:"
+                uuid, _, oid = rest.partition(":")
+                yield (symbol, uuid, oid)
+
+    def __len__(self) -> int:
+        return sum(
+            self.client.execute_command("HLEN", k)
+            for k in self.client.keys("*:comparison")
+        )
+
+    def clear(self) -> None:
+        keys = self.client.keys("*:comparison")
+        if keys:
+            self.client.execute_command("DEL", *keys)
+
+    # -- the admission hot path -------------------------------------------
+    def consume_batch(self, keys: list[Key]) -> list[bool]:
+        cmds = []
+        for key in keys:
+            k, f = self._loc(key)
+            cmds.append(("HDEL", k, f))
+        replies = self._check(self.client.pipeline(cmds))
+        return [r == 1 for r in replies]
+
+    @staticmethod
+    def _check(replies: list) -> list:
+        """An error reply must RAISE, never read as 'mark absent': treating
+        a store error (-LOADING, -OOM, -WRONGTYPE) as a missing mark would
+        silently drop acknowledged ADDs; raising lets the at-least-once
+        consumer replay the batch once the store recovers. Likewise a
+        failed mark RESTORE (__ior__) must not pass silently — the replay
+        depends on those marks being back."""
+        for r in replies:
+            if isinstance(r, Exception):
+                raise r
+        return replies
+
+
+def make_marker(pool):
+    """Gateway-side mark callable for a pool NOT attached to an engine —
+    the split-process gateway's equivalent of MatchEngine.mark
+    (main.go:42-45: ADDs mark, cancels never do)."""
+
+    def mark(order) -> None:
+        if order.action is Action.ADD:
+            pool.add((order.symbol, order.uuid, order.oid))
+
+    return mark

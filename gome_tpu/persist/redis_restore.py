@@ -272,5 +272,8 @@ def restore_from_redis(engine, store, symbols: list[str] | None = None) -> int:
         "env_hi": env_hi.tolist(),
     }
     batch.import_state(state)
-    engine.pre_pool = set(all_marks)
+    # In place (the pool object may be shared with a gateway); plain set
+    # assignment would also silently bypass a remote marker store.
+    engine.pre_pool.clear()
+    engine.pre_pool.update(all_marks)
     return total

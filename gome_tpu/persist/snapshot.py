@@ -171,7 +171,11 @@ class Persister:
         if loaded is not None:
             manifest, books = loaded
             self.engine.batch.import_state({**manifest, "books": books})
-            self.engine.pre_pool = {tuple(k) for k in manifest["pre_pool"]}
+            # In place, not reassignment: the pool object may be a shared
+            # remote marker store (prepool.RespPrePool) the gateway also
+            # holds.
+            self.engine.pre_pool.clear()
+            self.engine.pre_pool.update(tuple(k) for k in manifest["pre_pool"])
             oq.rollback(manifest["order_committed"])
             # The feed may have committed past the cut before the crash;
             # replay regenerates byte-identical events, so rewind its cursor

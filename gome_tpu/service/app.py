@@ -37,6 +37,18 @@ class EngineService:
             auto_grow=e.auto_grow,
             kernel=e.kernel,
         )
+        if self.config.store.enabled:
+            # A `redis:` config section puts the pre-pool markers in the
+            # (Redis-compatible) store under the reference's exact schema —
+            # split gateway/consumer processes then share marker state the
+            # way the reference's three processes do (nodepool.go:14-28).
+            from ..engine.prepool import RespPrePool
+            from ..persist.resp import RespClient
+
+            st = self.config.store
+            self.engine.pre_pool = RespPrePool(
+                RespClient(st.host, st.port, password=st.password or None)
+            )
         self.persist = persist  # gome_tpu.persist.Persister or None
         on_batch = None
         if persist is not None:

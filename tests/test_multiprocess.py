@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import numpy as np
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 from gome_tpu.bus import decode_match_result, make_bus
@@ -154,3 +156,106 @@ def test_verify_books_catches_corruption():
     engine.batch.books = jax.device_put(books._replace(price=price))
     with pytest.raises(BookInvariantError):
         engine.batch.verify_books()
+
+
+_RESP_GATEWAY = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from gome_tpu.bus import encode_order, make_bus
+from gome_tpu.config import BusConfig
+from gome_tpu.engine.prepool import RespPrePool, make_marker
+from gome_tpu.persist.resp import RespClient
+from gome_tpu.types import Action, Order, Side
+from gome_tpu.utils.streams import doorder_stream
+
+pool = RespPrePool(RespClient(port={resp_port}))
+mark = make_marker(pool)
+bus = make_bus(BusConfig(backend="file", dir={busdir!r}))
+
+orders = list(doorder_stream(n=80))
+# The race (SURVEY 2.3.3): the gateway ACCEPTED raced:oid=race (marked it)
+# but its DoOrder publish lost the race to a concurrent DeleteOrder
+# publish, so the DEL lands in doOrder first.
+add = Order(uuid="u9", oid="race", symbol="raced", side=Side.BUY,
+            price=3_000_000, volume=7)
+delete = Order(uuid="u9", oid="race", symbol="raced", side=Side.BUY,
+               price=3_000_000, volume=0, action=Action.DEL)
+mark(add)                      # gateway handler marked at accept
+for o in orders:
+    mark(o)                    # main.go:44-45 (ADDs only)
+payloads = [encode_order(delete), encode_order(add)]
+payloads += [encode_order(o) for o in orders]
+bus.order_queue.publish_batch(payloads)
+print(len(payloads))
+"""
+
+
+def test_three_process_prepool_reference_topology(tmp_path):
+    """The reference's deployment shape with reference semantics: a marker
+    server process (fake Redis speaking RESP2), a gateway process that
+    marks the pre-pool THERE and publishes to the file bus, and this
+    consumer process which never calls engine.mark — admission state flows
+    exclusively through the shared marker store, and the
+    cancel-before-consume race drops the queued ADD exactly as
+    engine.go:58-62 does."""
+    from gome_tpu.engine.prepool import RespPrePool
+    from gome_tpu.persist.resp import RespClient
+    from gome_tpu.types import Action, Order, Side
+
+    busdir = str(tmp_path / "bus")
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "gome_tpu.persist.respserver", "--port", "0"],
+        stdout=subprocess.PIPE, text=True, cwd=_REPO,
+    )
+    try:
+        ready = srv.stdout.readline().split()
+        assert ready and ready[0] == "READY", ready
+        resp_port = int(ready[1])
+
+        out = subprocess.run(
+            [
+                sys.executable, "-c",
+                _RESP_GATEWAY.format(
+                    repo=_REPO, busdir=busdir, resp_port=resp_port
+                ),
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        n_published = int(out.stdout.strip())
+
+        # Consumer process (this one): NO engine.mark anywhere — admission
+        # reads the marker server the gateway wrote.
+        bus = make_bus(BusConfig(backend="file", dir=busdir))
+        engine = MatchEngine(BookConfig(cap=64, max_fills=8), n_slots=4)
+        engine.pre_pool = RespPrePool(RespClient(port=resp_port))
+        consumer = OrderConsumer(engine, bus, batch_n=64)
+        drained = consumer.drain()
+        assert drained == n_published
+
+        # Expected stream from the oracle under the same race interleaving.
+        oracle = OracleEngine()
+        add = Order(uuid="u9", oid="race", symbol="raced", side=Side.BUY,
+                    price=3_000_000, volume=7)
+        delete = Order(uuid="u9", oid="race", symbol="raced", side=Side.BUY,
+                       price=3_000_000, volume=0, action=Action.DEL)
+        oracle.pre_pool.add(("raced", "u9", "race"))
+        oracle.queue.append(delete)
+        oracle.queue.append(add)
+        for o in doorder_stream(n=80):
+            oracle.submit(o)
+        expected = oracle.drain()
+
+        msgs = bus.match_queue.read_from(0, 10_000)
+        events = [decode_match_result(m.body) for m in msgs]
+        assert events == expected
+        # The raced ADD was dropped by admission: never rested anywhere.
+        assert engine.stats.dropped_no_prepool == 1
+        assert oracle.stats.dropped_no_prepool == 1
+        lane = engine.batch.symbol_lane("raced")
+        books = engine.batch.lane_books()
+        assert int(np.asarray(books.count)[lane].sum()) == 0
+        engine.batch.verify_books()
+    finally:
+        srv.terminate()
+        srv.wait(timeout=10)

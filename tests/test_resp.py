@@ -1,0 +1,211 @@
+"""RESP2 wire layer (persist.resp + persist.respserver) and the RESP-backed
+pre-pool (engine.prepool.RespPrePool): protocol round trips over a real
+socket, pipelining, the reference's exact marker schema, wire-level book
+export -> import (bit-identical, restore-then-continue oracle parity), and
+admission equivalence between the local and remote pools."""
+
+import numpy as np
+import pytest
+
+from gome_tpu.engine import BookConfig, MatchEngine
+from gome_tpu.engine.prepool import LocalPrePool, RespPrePool, make_marker
+from gome_tpu.oracle import OracleEngine
+from gome_tpu.persist import restore_from_redis
+from gome_tpu.persist.redis_schema import export_to_redis
+from gome_tpu.persist.resp import RespClient, RespError
+from gome_tpu.persist.respserver import FakeRedisServer
+from gome_tpu.types import Action, Order, Side
+from gome_tpu.utils.streams import multi_symbol_stream
+
+from test_redis_restore import _books_semantically_equal, _run_marked
+
+
+@pytest.fixture()
+def server():
+    with FakeRedisServer() as srv:
+        yield srv
+
+
+@pytest.fixture()
+def client(server):
+    with RespClient(port=server.port) as c:
+        yield c
+
+
+def test_protocol_basics(client):
+    assert client.ping()
+    assert client.execute_command("ECHO", "héllo") == "héllo".encode()
+    assert client.hset("h", "f1", "v1") == 1
+    assert client.hset("h", "f1", "v2") == 0  # overwrite, not new
+    assert client.execute_command("HGET", "h", "f1") == b"v2"
+    assert client.hexists("h", "f1")
+    assert not client.hexists("h", "nope")
+    assert client.hgetall("h") == {"f1": "v2"}
+    assert client.hdel("h", "f1", "zzz") == 1
+    assert client.hgetall("h") == {}
+    assert client.execute_command("HGET", "h", "f1") is None
+    client.execute_command("ZADD", "z", 2.5, "b", 1, "a", 10, "c")
+    assert client.zrange("z", 0, -1) == ["a", "b", "c"]
+    assert client.execute_command(
+        "ZRANGE", "z", 0, -1, "WITHSCORES"
+    ) == [b"a", b"1", b"b", b"2.5", b"c", b"10"]
+    assert client.execute_command("ZRANGEBYSCORE", "z", "-inf", 2.5) == [
+        b"a", b"b",
+    ]
+    assert client.execute_command("ZREVRANGEBYSCORE", "z", "+inf", 2) == [
+        b"c", b"b",
+    ]
+    assert client.execute_command("ZREM", "z", "b") == 1
+    assert sorted(client.keys("*")) == ["h", "z"] or sorted(
+        client.keys("*")
+    ) == ["z"]  # h was emptied and dropped
+    assert client.execute_command("DEL", "z") == 1
+    with pytest.raises(RespError):
+        client.execute_command("NOSUCHCMD")
+    client.flushdb()
+    assert client.keys("*") == []
+
+
+def test_large_values_and_pipelining(client):
+    big = "x" * 300_000
+    client.hset("big", "f", big)
+    assert client.hgetall("big")["f"] == big
+    cmds = [("HSET", "p", f"f{i}", str(i)) for i in range(5_000)]
+    cmds.insert(2500, ("BADCMD",))  # error must come back in-place
+    replies = client.pipeline(cmds)
+    assert len(replies) == 5_001
+    assert isinstance(replies[2500], RespError)
+    assert sum(r == 1 for r in replies if isinstance(r, int)) == 5_000
+    assert len(client.hgetall("p")) == 5_000
+
+
+def test_resp_prepool_schema_and_semantics(client):
+    pool = RespPrePool(client)
+    k1 = ("eth2usdt", "u1", "o1")
+    k2 = ("eth2usdt", "u1", "o2")
+    k3 = ("btc2usdt", "u2", "o1")
+    pool.add(k1)
+    pool.add(k3)
+    # Reference schema on the wire: S:comparison hash, S:U:O field
+    # (nodepool.go:14-16, ordernode.go:89-92).
+    assert client.hgetall("eth2usdt:comparison") == {"eth2usdt:u1:o1": "1"}
+    assert client.hgetall("btc2usdt:comparison") == {"btc2usdt:u2:o1": "1"}
+    assert k1 in pool and k3 in pool and k2 not in pool
+    pool |= {k2}
+    assert sorted(pool) == sorted([k1, k2, k3])
+    assert len(pool) == 3
+    assert pool.consume_batch([k1, k1, k2]) == [True, False, True]
+    assert k1 not in pool
+    pool.discard(k3)
+    assert len(pool) == 0
+    pool.update([k1, k2])
+    pool.clear()
+    assert len(pool) == 0
+
+
+def _mk_engine(**kw):
+    kw.setdefault("config", BookConfig(cap=32, max_fills=8))
+    kw.setdefault("n_slots", 8)
+    kw.setdefault("max_t", 8)
+    return MatchEngine(**kw)
+
+
+def test_remote_prepool_admission_matches_local(server):
+    """A MatchEngine with its pre-pool in the RESP store admits identically
+    to the in-process pool — including the cancel-before-consume drop —
+    and the event streams match the oracle."""
+    orders = multi_symbol_stream(n=200, n_symbols=4, seed=23, cancel_prob=0.2)
+    local = _mk_engine()
+    got_local = _run_marked(local, orders)
+
+    remote = _mk_engine()
+    remote.pre_pool = RespPrePool(RespClient(port=server.port))
+    got_remote = _run_marked(remote, orders)
+    assert got_remote == got_local
+    oracle = OracleEngine()
+    want = [r for o in orders for r in oracle.process(o)]
+    assert got_remote == want
+    _books_semantically_equal(remote, local)
+    assert remote.stats.dropped_no_prepool == local.stats.dropped_no_prepool
+
+
+def test_remote_prepool_cancel_before_consume_drop(server):
+    """The reference race (SURVEY §2.3.3): a DEL consumed before its ADD
+    clears the marker (engine.go:88-90), so the later ADD dies unmarked
+    (engine.go:58-62). With the marker store remote, the same flow must
+    drop the ADD."""
+    engine = _mk_engine()
+    engine.pre_pool = RespPrePool(RespClient(port=server.port))
+    add = Order(uuid="u", oid="x", symbol="s", side=Side.BUY, price=100,
+                volume=5)
+    engine.mark(add)  # gateway accepts the ADD, marks
+    delete = Order(uuid="u", oid="x", symbol="s", side=Side.BUY, price=100,
+                   volume=0, action=Action.DEL)
+    # Queue order raced: DEL drains first, clears the mark, misses on book.
+    assert engine.process([delete]) == []
+    assert engine.process([add]) == []  # dropped: marker gone
+    assert engine.stats.dropped_no_prepool == 1
+    books = engine.batch.lane_books()
+    assert int(np.asarray(books.count).sum()) == 0  # nothing rested
+
+
+def test_wire_level_export_import_round_trip(server):
+    """redis_schema export and redis_restore import BOTH over the socket:
+    books round-trip bit-identically and the restored engine continues
+    matching with oracle parity (the round-2 gap: the schema had only ever
+    been exercised against the in-memory DictRedis)."""
+    stream = multi_symbol_stream(n=400, n_symbols=5, seed=31, cancel_prob=0.15)
+    head, tail = stream[:300], stream[300:]
+    src = _mk_engine()
+    oracle = OracleEngine()
+    for o in head:
+        src.mark(o)
+        src.process([o])
+        oracle.process(o)
+
+    with RespClient(port=server.port) as c:
+        n_cmds = export_to_redis(src, client=c)
+    assert n_cmds > 0
+
+    dst = _mk_engine()
+    with RespClient(port=server.port) as c2:
+        imported = restore_from_redis(dst, c2)
+    assert imported == int(np.asarray(src.batch.lane_books().count).sum())
+    _books_semantically_equal(dst, src)
+    assert set(dst.pre_pool) == set(src.pre_pool)
+
+    # Continue the stream on the restored engine: oracle parity holds.
+    got = _run_marked(dst, tail)
+    want = [r for o in tail for r in oracle.process(o)]
+    assert got == want
+
+
+def test_resp_prepool_raises_on_store_errors():
+    """An error reply (e.g. -LOADING, -WRONGTYPE) must raise, not read as
+    'mark absent' — conflating the two would silently drop acknowledged
+    ADDs; raising lets the at-least-once consumer replay the batch."""
+
+    class ErrClient:
+        def pipeline(self, cmds):
+            return [RespError("LOADING Redis is loading the dataset")] * len(
+                cmds
+            )
+
+    pool = RespPrePool(ErrClient())
+    with pytest.raises(RespError):
+        pool.consume_batch([("s", "u", "1")])
+    with pytest.raises(RespError):
+        pool.update([("s", "u", "1")])
+
+
+def test_make_marker_marks_only_adds(server):
+    pool = RespPrePool(RespClient(port=server.port))
+    mark = make_marker(pool)
+    add = Order(uuid="u", oid="1", symbol="s", side=Side.BUY, price=1,
+                volume=1)
+    delete = Order(uuid="u", oid="2", symbol="s", side=Side.BUY, price=1,
+                   volume=0, action=Action.DEL)
+    mark(add)
+    mark(delete)
+    assert ("s", "u", "1") in pool
+    assert ("s", "u", "2") not in pool
