@@ -165,11 +165,20 @@ def is_frame(body: bytes) -> bool:
 
 def _pack_id_table(table, used: np.ndarray) -> bytes:
     """Frame-local id table: u32 count + padded 'S' column of the USED
-    strings (operator.itemgetter gathers from the process-lifetime table at
-    C speed; no per-string Python loop)."""
+    strings. A native-interner table (gather_padded) packs without
+    materializing ANY Python strings; Python-list tables gather via
+    operator.itemgetter at C speed."""
+    count = len(used)
+    gather = getattr(table, "gather_padded", None)
+    if gather is not None and count:
+        arr = gather(np.ascontiguousarray(used, np.int64))
+        return (
+            struct.pack("<I", count)
+            + struct.pack("<H", arr.dtype.itemsize)
+            + arr.tobytes()
+        )
     import operator
 
-    count = len(used)
     if count == 0:
         gathered = []
     elif count == 1:
@@ -207,10 +216,27 @@ def encode_event_frame(batch) -> bytes:
             else np.zeros(0, np.int64)
         )
         tables.append(_pack_id_table(table, used))
-        for k in cols:
-            local_cols[k] = (
-                np.searchsorted(used, c[k]) if n else np.zeros(0, np.int64)
-            )
+        if n and len(used):
+            top = int(used[-1])
+            if top < (1 << 28):
+                # Dense O(1) remap instead of per-column searchsorted:
+                # scatter frame-local ids into a position-indexed map.
+                # np.empty is a lazy mmap and only the touched pages
+                # materialize, but the map still scales with the LARGEST
+                # id (the oid interner grows one id per order for the
+                # process lifetime) — so cap it at 2^28 ids (1 GB u32,
+                # ~270M orders) and degrade to searchsorted beyond, which
+                # keeps scratch O(batch).
+                remap = np.empty(top + 1, np.uint32)
+                remap[used] = np.arange(len(used), dtype=np.uint32)
+                for k in cols:
+                    local_cols[k] = remap[c[k]]
+            else:
+                for k in cols:
+                    local_cols[k] = np.searchsorted(used, c[k])
+        else:
+            for k in cols:
+                local_cols[k] = np.zeros(0, np.int64)
     for name, dt in _EVENT_NUM:
         col = local_cols.get(name, c.get(name))
         parts.append(np.ascontiguousarray(col, dt).tobytes())

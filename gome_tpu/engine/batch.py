@@ -317,8 +317,12 @@ class BatchEngine:
                     )
         self._sharded_steppers: dict = {}  # BookConfig -> jitted step
         self.books = self._place(init_books(config, n_slots))
+        from .nativehost import make_interner
+
         self.symbols = Interner()  # symbol -> lane id + 1 offset handled below
-        self.oids = Interner()
+        # oids are the one per-order-unique string column — interned in C++
+        # when the toolchain allows (nativehost; ~10x the dict loop).
+        self.oids = make_interner()
         self.uids = Interner()
         self.stats = EngineStats()
         # Price rebasing (32-bit books only): device prices are stored
@@ -1020,8 +1024,10 @@ class BatchEngine:
             self._place(books) if self.mesh is not None
             else jax.device_put(books)
         )
+        from .nativehost import make_interner
+
         self.symbols = Interner.from_list(list(state["symbols"]))
-        self.oids = Interner.from_list(list(state["oids"]))
+        self.oids = make_interner(from_list=list(state["oids"]))
         self.uids = Interner.from_list(list(state["uids"]))
         self._rebase = jnp.dtype(self.config.dtype).itemsize <= 4
         n = self.n_slots

@@ -91,11 +91,16 @@ def _frame_arrays(eng: BatchEngine, cols: dict) -> dict:
     uid_ids = uid_of[cols["uuid_idx"]]
     # oids are raw per-order strings and typically (in exchange flow)
     # almost all NEW — a dedup sort would cost more than it saves; intern
-    # directly (the interner handles repeats).
-    intern = eng.oids.intern
-    oid_ids = np.fromiter(
-        (intern(o.decode()) for o in cols["oids"].tolist()), np.int64, n
-    )
+    # directly (the interner handles repeats). One native call when the
+    # C++ interner backs eng.oids.
+    intern_batch = getattr(eng.oids, "intern_batch", None)
+    if intern_batch is not None:
+        oid_ids = intern_batch(cols["oids"])
+    else:
+        intern = eng.oids.intern
+        oid_ids = np.fromiter(
+            (intern(o.decode()) for o in cols["oids"].tolist()), np.int64, n
+        )
 
     is_add = action == ACTION_ADD
     bad = is_add & (volume <= 0)
@@ -118,24 +123,31 @@ def _frame_arrays(eng: BatchEngine, cols: dict) -> dict:
     drop = _prepare_bases_vec(eng, lanes, action, kind, price)
     bases = eng.price_base[lanes]
 
-    # Occurrence index of each op within its lane, in arrival order: a
-    # stable sort by lane groups each lane's ops contiguously (arrival
-    # order preserved within the group); index-in-group = arange minus the
-    # group's start.
+    # Occurrence index of each op within its lane, in arrival order. One
+    # native linear pass when available; else the numpy stable-sort trick
+    # (sort by lane groups each lane's ops contiguously with arrival order
+    # preserved; index-in-group = arange minus the group's start).
     keep = ~drop
-    t = np.full(n, -1, np.int64)
-    if keep.any():
-        ki = np.nonzero(keep)[0]
-        order = np.argsort(lanes[ki], kind="stable")
-        sorted_lanes = lanes[ki][order]
-        starts = np.concatenate(
-            ([0], np.nonzero(np.diff(sorted_lanes))[0] + 1)
+    from . import nativehost
+
+    if nativehost.available():
+        t = nativehost.occurrences(
+            lanes, None if keep.all() else keep, eng.n_slots
         )
-        group_start = np.zeros(len(sorted_lanes), np.int64)
-        group_start[starts] = starts
-        group_start = np.maximum.accumulate(group_start)
-        occ = np.arange(len(sorted_lanes)) - group_start
-        t[ki[order]] = occ
+    else:
+        t = np.full(n, -1, np.int64)
+        if keep.any():
+            ki = np.nonzero(keep)[0]
+            order = np.argsort(lanes[ki], kind="stable")
+            sorted_lanes = lanes[ki][order]
+            starts = np.concatenate(
+                ([0], np.nonzero(np.diff(sorted_lanes))[0] + 1)
+            )
+            group_start = np.zeros(len(sorted_lanes), np.int64)
+            group_start[starts] = starts
+            group_start = np.maximum.accumulate(group_start)
+            occ = np.arange(len(sorted_lanes)) - group_start
+            t[ki[order]] = occ
 
     return dict(
         n=n, action=action, side=side, kind=kind, price=price,
@@ -160,7 +172,11 @@ def pack_frame_grids(eng: BatchEngine, a: dict) -> list[tuple]:
         use_dense, n_rows, lane_ids = eng._grid_geometry(live)
         remaining_t = t - t_off
         if use_dense:
-            rows = np.searchsorted(live, lanes)
+            # Dense O(1) lane -> row map (searchsorted over the full frame
+            # costs ~10x more at frame shape).
+            row_of = np.empty(eng.n_slots, np.int64)
+            row_of[live] = np.arange(len(live), dtype=np.int64)
+            rows = row_of[lanes]
             t_grid = min(
                 _next_pow2(int(remaining_t[active].max()) + 1),
                 max(eng.dense_t_max, eng.max_t),
@@ -180,18 +196,20 @@ def pack_frame_grids(eng: BatchEngine, a: dict) -> list[tuple]:
             for name in _GRID_FIELDS
         }
         pr, pt = rows[packed], remaining_t[packed]
+        flat = pr * t_grid + pt  # one index computation for all 7 scatters
         is_mkt = (a["kind"][packed] == MARKET) & (
             a["action"][packed] == ACTION_ADD
         )
-        grid["action"][pr, pt] = a["action"][packed]
-        grid["side"][pr, pt] = a["side"][packed]
-        grid["is_market"][pr, pt] = is_mkt
-        grid["price"][pr, pt] = np.where(
+        put = lambda name, val: grid[name].reshape(-1).__setitem__(flat, val)
+        put("action", a["action"][packed])
+        put("side", a["side"][packed])
+        put("is_market", is_mkt)
+        put("price", np.where(
             is_mkt, 0, a["price"][packed] - a["bases"][packed]
-        )
-        grid["volume"][pr, pt] = a["volume"][packed]
-        grid["oid"][pr, pt] = a["oid_ids"][packed]
-        grid["uid"][pr, pt] = a["uid_ids"][packed]
+        ))
+        put("volume", a["volume"][packed])
+        put("oid", a["oid_ids"][packed])
+        put("uid", a["uid_ids"][packed])
 
         meta = {
             "lane": lanes[packed],
@@ -347,6 +365,13 @@ def _decode_compact(eng, meta, shape, fetched) -> dict:
     t_len, k = shape
     totals, fills, cancels = fetched
     nf, nc = int(totals[0]), int(totals[1])
+
+    from . import nativehost
+
+    if nativehost.available():
+        return nativehost.decode_compact(
+            meta, t_len, k, nf, nc, fills, cancels
+        )
 
     # (row, t) -> packed-op index join table.
     n_rows = int(meta["_n_rows"])
@@ -564,13 +589,30 @@ def _prepare_bases_vec(eng, lanes, action, kind, price) -> np.ndarray:
     if adm.any():
         al = lanes[adm]
         ap = price[adm]
-        uniq = np.unique(al)
-        lo = np.full(eng.n_slots, np.iinfo(np.int64).max)
-        hi = np.full(eng.n_slots, np.iinfo(np.int64).min)
-        np.minimum.at(lo, al, ap)
-        np.maximum.at(hi, al, ap)
-        for lane in uniq.tolist():
-            eng._admit_lane_range(int(lane), int(lo[lane]), int(hi[lane]))
+        # Steady-state fast path: prices already inside their lane's
+        # admitted envelope AND within REBASE_LIMIT of its base need no
+        # work at all — only the violating lanes run the (ufunc.at +
+        # Python) admission below. The base-distance check matters: after
+        # asymmetric growth a price can sit inside [env_lo, env_hi] yet
+        # far enough from the base that _admit_lane_range would RECENTER
+        # (batch.py REBASE_LIMIT); skipping that would leave price_base
+        # stale and drop later DELs near the far envelope edge.
+        inside = (
+            eng._base_set[al]
+            & (ap >= eng._env_lo[al])
+            & (ap <= eng._env_hi[al])
+            & (np.abs(ap - eng.price_base[al]) <= eng.REBASE_LIMIT)
+        )
+        if not inside.all():
+            viol = ~inside
+            al, ap = al[viol], ap[viol]
+            uniq = np.unique(al)
+            lo = np.full(eng.n_slots, np.iinfo(np.int64).max)
+            hi = np.full(eng.n_slots, np.iinfo(np.int64).min)
+            np.minimum.at(lo, al, ap)
+            np.maximum.at(hi, al, ap)
+            for lane in uniq.tolist():
+                eng._admit_lane_range(int(lane), int(lo[lane]), int(hi[lane]))
     dels = action == ACTION_DEL
     if dels.any():
         dl = lanes[dels]

@@ -25,7 +25,7 @@ from __future__ import annotations
 from ..types import Action, MatchResult, Order
 from .batch import BatchEngine, EngineStats
 from .book import BookConfig
-from .prepool import LocalPrePool, consume_batch_of
+from .prepool import consume_batch_of, make_prepool
 
 
 class MatchEngine:
@@ -52,11 +52,12 @@ class MatchEngine:
             auto_grow=auto_grow,
             kernel=kernel,
         )
-        # The marker store shared with the gateway. In-process by default;
+        # The marker store shared with the gateway. In-process by default
+        # (C++-backed when the toolchain allows — prepool.NativePrePool);
         # split-process deployments assign a prepool.RespPrePool here (and
         # in the gateway process) so the markers live in a Redis-compatible
         # server exactly as the reference's do (nodepool.go:14-28).
-        self.pre_pool = LocalPrePool()
+        self.pre_pool = make_prepool()
 
     # -- gateway side ------------------------------------------------------
     def mark(self, order: Order) -> None:
@@ -176,10 +177,33 @@ class MatchEngine:
             raise
 
     def admit_frame(self, cols: dict) -> tuple[dict, set]:
-        """Frame admission: returns (filtered columns, the pre-pool keys
-        consumed) — the caller restores `consumed` if the batch later
-        fails (at-least-once replay must not drop re-admitted ADDs)."""
+        """Frame admission: returns (filtered columns, the consumed marks)
+        — the caller restores `consumed` (pre_pool |= consumed) if the
+        batch later fails (at-least-once replay must not drop re-admitted
+        ADDs)."""
         import numpy as np
+
+        consume_frame = getattr(self.pre_pool, "consume_frame", None)
+        if consume_frame is not None:
+            # Fused native pass: compose keys + pop markers + masks in C++.
+            keep, consumed = consume_frame(cols)
+            dropped = int(
+                ((cols["action"] == int(Action.ADD)) & ~keep).sum()
+            )
+            self.stats.dropped_no_prepool += dropped
+            if not keep.all():
+                cols = dict(
+                    cols,
+                    n=int(keep.sum()),
+                    **{
+                        k: np.ascontiguousarray(cols[k][keep])
+                        for k in (
+                            "action", "side", "kind", "price", "volume",
+                            "symbol_idx", "uuid_idx", "oids",
+                        )
+                    },
+                )
+            return cols, consumed
 
         n = int(cols["n"])
         action = cols["action"].tolist()

@@ -12,7 +12,7 @@ import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-SOURCES = ["filelog.cc", "ordercodec.cc"]
+SOURCES = ["filelog.cc", "ordercodec.cc", "hostops.cc"]
 LIB = "libgome_native.so"
 
 

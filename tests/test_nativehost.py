@@ -1,0 +1,159 @@
+"""Native host ops (native/hostops.cc via engine.nativehost): differential
+parity with the pure-Python implementations — the C++ interner against
+engine.host.Interner, the C++ pre-pool against LocalPrePool, including the
+fused frame-admission pass, rollback restore, and snapshot iteration."""
+
+import numpy as np
+import pytest
+
+from gome_tpu.engine.host import Interner
+from gome_tpu.engine.nativehost import NativeInterner, available
+from gome_tpu.engine.prepool import (
+    LocalPrePool,
+    NativeConsumed,
+    NativePrePool,
+)
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native toolchain unavailable"
+)
+
+
+def test_interner_parity_randomized():
+    rng = np.random.default_rng(3)
+    py, nat = Interner(), NativeInterner()
+    words = [f"w{int(rng.integers(0, 500))}" for _ in range(2_000)]
+    for w in words:
+        assert py.intern(w) == nat.intern(w)
+    assert len(py) == len(nat)
+    assert py.to_list() == nat.to_list()
+    for i in range(len(py)):
+        assert py.lookup(i) == nat.lookup(i)
+    assert py.get("w0") == nat.get("w0")
+    assert py.get("missing") is None and nat.get("missing") is None
+    # batch intern matches one-by-one interning
+    more = np.array(
+        [f"x{int(rng.integers(0, 100))}".encode() for _ in range(500)],
+        dtype="S8",
+    )
+    ids_nat = nat.intern_batch(more)
+    ids_py = np.array([py.intern(b.decode()) for b in more.tolist()])
+    np.testing.assert_array_equal(ids_nat, ids_py)
+    # gather round-trips
+    some = np.array([1, 5, 0, len(py) - 1], np.int64)
+    got = [s.decode() for s in nat.gather_padded(some).tolist()]
+    want = [py.lookup(int(i)) for i in some]
+    assert got == want
+    # table view quacks like the list
+    assert nat.table[3] == py.table[3]
+    assert list(nat.table) == list(py.table)
+    # from_list round trip
+    nat2 = NativeInterner.from_list(py.to_list())
+    assert nat2.to_list() == py.to_list()
+    with pytest.raises(IndexError):
+        nat.lookup(10_000_000)
+
+
+def _frame_cols(rng, n, n_syms=5, n_uuids=3, nop_prob=0.1, del_prob=0.2):
+    symbols = [f"sym{i}" for i in range(n_syms)]
+    uuids = [f"u{i}" for i in range(n_uuids)]
+    action = np.where(
+        rng.random(n) < nop_prob,
+        0,
+        np.where(rng.random(n) < del_prob, 2, 1),
+    ).astype(np.uint8)
+    return {
+        "n": n,
+        "action": action,
+        "symbols": symbols,
+        "symbol_idx": rng.integers(0, n_syms, n).astype(np.uint32),
+        "uuids": uuids,
+        "uuid_idx": rng.integers(0, n_uuids, n).astype(np.uint32),
+        "oids": np.array(
+            [f"o{int(rng.integers(0, n))}".encode() for i in range(n)],
+            dtype="S8",
+        ),
+    }
+
+
+def _keys_of(cols):
+    return [
+        (
+            cols["symbols"][int(cols["symbol_idx"][i])],
+            cols["uuids"][int(cols["uuid_idx"][i])],
+            cols["oids"][i].decode(),
+        )
+        for i in range(cols["n"])
+    ]
+
+
+def _local_admit(pool: LocalPrePool, cols):
+    """The Python-path admission semantics, spelled out as the oracle."""
+    keep = np.zeros(cols["n"], bool)
+    consumed = set()
+    for i, (a, key) in enumerate(zip(cols["action"].tolist(), _keys_of(cols))):
+        if a == 1:  # ADD
+            if key in pool:
+                pool.discard(key)
+                consumed.add(key)
+                keep[i] = True
+        elif a == 2:  # DEL
+            keep[i] = True
+            if key in pool:
+                pool.discard(key)
+                consumed.add(key)
+    return keep, consumed
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_prepool_frame_admission_parity(seed):
+    rng = np.random.default_rng(seed)
+    cols = _frame_cols(rng, 400)
+    keys = _keys_of(cols)
+    # Mark a random subset (some ADDs marked, some not; some DELs racing).
+    marked = [k for k in keys if rng.random() < 0.7]
+    local = LocalPrePool(marked)
+    native = NativePrePool()
+    native |= marked
+    assert native == set(local)
+
+    keep_l, consumed_l = _local_admit(local, cols)
+    keep_n, consumed_n = native.consume_frame(cols)
+    np.testing.assert_array_equal(np.asarray(keep_n), keep_l)
+    assert isinstance(consumed_n, NativeConsumed)
+    assert set(consumed_n) == consumed_l
+    assert len(consumed_n) == len(consumed_l)
+    assert native == set(local)  # post-admission pool state identical
+
+    # Rollback: restoring consumed marks converges the two pools again.
+    local |= consumed_l
+    native |= consumed_n
+    assert native == set(local)
+
+
+def test_prepool_mark_frame_matches_per_order_marks():
+    rng = np.random.default_rng(9)
+    cols = _frame_cols(rng, 300)
+    a = NativePrePool()
+    a.mark_frame(cols)
+    b = LocalPrePool()
+    for key, act in zip(_keys_of(cols), cols["action"].tolist()):
+        if act == 1:  # ADDs only (main.go:42-45)
+            b.add(key)
+    assert a == set(b)
+
+
+def test_prepool_set_protocol():
+    p = NativePrePool()
+    k = ("eth2usdt", "u1", "42")
+    assert k not in p
+    p.add(k)
+    p.add(k)  # idempotent
+    assert k in p and len(p) == 1
+    p.discard(("nope",) * 3)  # no-op
+    assert sorted(p) == [k]
+    p.update([("a", "b", "c")])
+    assert len(p) == 2
+    p.clear()
+    assert len(p) == 0 and list(p) == []
+    assert p.consume_batch([k]) == [False]
