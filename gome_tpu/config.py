@@ -157,12 +157,24 @@ class PersistConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class OpsConfig:
+    """Operator HTTP endpoint (/metrics Prometheus text + /healthz JSON) —
+    an extension beyond the reference (which has logging only, SURVEY
+    §5.5). Disabled unless an `ops:` section appears in config.yaml."""
+
+    host: str = "127.0.0.1"
+    port: int = 9109
+    enabled: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     grpc: GrpcConfig = GrpcConfig()
     store: StoreConfig = StoreConfig()
     bus: BusConfig = BusConfig()
     engine: EngineConfig = EngineConfig()
     persist: PersistConfig = PersistConfig()
+    ops: OpsConfig = OpsConfig()
 
 
 def _build(cls, raw: dict[str, Any], section: str):
@@ -206,9 +218,15 @@ def load_config(path: str | None = None) -> Config:
     persist_raw = dict(raw.get("persist", {}) or {})
     if persist_raw:
         persist_raw.setdefault("enabled", True)
+    ops_raw = dict(raw.get("ops", {}) or {})
+    if ops_raw:
+        ops_raw.setdefault("enabled", True)
     raw.pop("mysql", None)  # dead section, config.yaml.example:16-21
 
-    known = {"grpc", "redis", "rabbitmq", "bus", "gomengine", "engine", "persist"}
+    known = {
+        "grpc", "redis", "rabbitmq", "bus", "gomengine", "engine",
+        "persist", "ops",
+    }
     unknown = set(raw) - known
     if unknown:
         raise ValueError(f"unknown config sections: {sorted(unknown)}")
@@ -219,4 +237,5 @@ def load_config(path: str | None = None) -> Config:
         bus=_build(BusConfig, bus_raw, "bus"),
         engine=_build(EngineConfig, engine_raw, "engine"),
         persist=_build(PersistConfig, persist_raw, "persist"),
+        ops=_build(OpsConfig, ops_raw, "ops"),
     )

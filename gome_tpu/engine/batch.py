@@ -305,6 +305,10 @@ class BatchEngine:
         self.mesh = mesh
         self.dense = dense
         self.dense_t_max = dense_t_max
+        # Grow-only geometry ratchets (see _grid_geometry / frame packing):
+        # compiled grid shapes must not oscillate across pow2 buckets.
+        self._dense_rows_floor = 8
+        self._dense_t_floor = 8
         if mesh is not None:
             # Every place n_slots can be set (init, growth, restore) must
             # produce a mesh multiple; enforcing the two static bounds here
@@ -426,7 +430,15 @@ class BatchEngine:
         )
         if not use_dense:
             return False, self.n_slots, None
-        n_rows = max(8, _next_pow2(len(live)))
+        # Grow-only row bucket ("ratchet"): live-lane counts hovering at a
+        # pow2 boundary would otherwise flip the compiled grid shape frame
+        # to frame — and one fresh XLA compile costs more than thousands
+        # of frames of matching. Sentinel padding rows are cheap; larger-
+        # than-needed grids are not (so the ratchet, not max shape).
+        n_rows = max(8, _next_pow2(len(live)), self._dense_rows_floor)
+        if n_rows >= self.n_slots:
+            return False, self.n_slots, None
+        self._dense_rows_floor = n_rows
         lane_ids = np.full(n_rows, self.n_slots, np.int64)
         lane_ids[: len(live)] = live
         return True, n_rows, lane_ids
@@ -703,9 +715,10 @@ class BatchEngine:
         if use_dense:
             row = np.searchsorted(live, lanes)
             t_grid = min(
-                _next_pow2(max(level.values())),
+                max(_next_pow2(max(level.values())), self._dense_t_floor),
                 max(self.dense_t_max, self.max_t),
             )
+            self._dense_t_floor = t_grid
         else:
             row = lanes
             t_grid = self.max_t
@@ -916,7 +929,7 @@ class BatchEngine:
                 )
 
                 r = ops.action.shape[0]
-                block_s = default_block_s(r)
+                block_s = default_block_s(r, self.config.cap)
                 if self._pallas_interpret and block_s is None:
                     block_s = interpret_block_s(r)
                 if block_s is not None and (
@@ -950,7 +963,7 @@ class BatchEngine:
             )
 
             s = ops.action.shape[0]
-            block_s = default_block_s(s)
+            block_s = default_block_s(s, self.config.cap)
             if self._pallas_interpret and block_s is None:
                 block_s = interpret_block_s(s)
             if block_s is not None and (

@@ -177,10 +177,16 @@ def pack_frame_grids(eng: BatchEngine, a: dict) -> list[tuple]:
             row_of = np.empty(eng.n_slots, np.int64)
             row_of[live] = np.arange(len(live), dtype=np.int64)
             rows = row_of[lanes]
+            # Depth ratchet, like the row bucket in _grid_geometry: a
+            # compiled shape must not oscillate with per-frame depth.
             t_grid = min(
-                _next_pow2(int(remaining_t[active].max()) + 1),
+                max(
+                    _next_pow2(int(remaining_t[active].max()) + 1),
+                    eng._dense_t_floor,
+                ),
                 max(eng.dense_t_max, eng.max_t),
             )
+            eng._dense_t_floor = t_grid
         else:
             rows = lanes
             t_grid = eng.max_t

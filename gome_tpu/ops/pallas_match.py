@@ -78,15 +78,23 @@ def interpret_block_s(s: int) -> int:
     return next(b for b in (8, 4, 2, 1) if s % b == 0)
 
 
-def default_block_s(s: int) -> int | None:
+def default_block_s(s: int, cap: int = 256) -> int | None:
     """The compiled kernel's lane-blocking policy, in ONE place: 128-lane
     blocks when the lane count divides, else one sublane-aligned whole-axis
     block (VMEM-bounded, so only for modest s; s % 8 != 0 hits unsupported
-    Mosaic relayouts). None means no valid blocking — callers fall back to
-    the scan path."""
-    if s % 128 == 0:
+    Mosaic relayouts). Deep books shrink the block: the resident per-block
+    book tiles are ~10 x block x 2*cap x 4 B, and Mosaic's scoped-VMEM
+    stack is 16 MB — cap=1024 at block 128 is a compile-time VMEM OOM.
+    None means no valid blocking — callers fall back to the scan path."""
+    # Valid blockings are 128-multiples or the whole axis (Mosaic lane-dim
+    # rule enforced in pallas_batch_step); within that, the book tile must
+    # fit the scoped-VMEM stack (~16 MB total; the in/out aliased tiles
+    # cost ~2x the nominal size, so budget the tile at 6 MB).
+    tile = lambda b: 10 * b * 2 * cap * 4
+    limit = 6 << 20
+    if s % 128 == 0 and tile(128) <= limit:
         return 128
-    if s <= 256 and s % 8 == 0:
+    if s <= 256 and s % 8 == 0 and tile(s) <= limit:
         return s
     return None
 

@@ -102,7 +102,7 @@ def sharded_batch_step(
 
         def stepper(books: BookState, ops: DeviceOp):
             s_local = ops.action.shape[0] // mesh.size
-            block = default_block_s(s_local)
+            block = default_block_s(s_local, config.cap)
             if block is None and interpret:
                 block = interpret_block_s(s_local)
             if block is None:

@@ -73,14 +73,24 @@ class EngineService:
             max_volume=LOT_MAX32 if e.dtype == "int32" else None,
         )
         self._server = None
+        self.ops = None
+        if self.config.ops.enabled:
+            from .ops import OpsServer
+
+            self.ops = OpsServer(
+                self, host=self.config.ops.host, port=self.config.ops.port
+            )
 
     def start(self):
-        """Start gRPC server + consumer + feed threads; returns self."""
+        """Start gRPC server + consumer + feed threads (+ the ops HTTP
+        endpoint when configured); returns self."""
         if self.persist is not None:
             self.persist.restore_latest()
         self._server = serve_gateway(self.gateway, self.config)
         self.consumer.start()
         self.feed.start()
+        if self.ops is not None:
+            self.ops.start()
         return self
 
     def stop(self):
@@ -89,6 +99,8 @@ class EngineService:
             self._server = None
         self.consumer.stop()
         self.feed.stop()
+        if self.ops is not None:
+            self.ops.stop()
 
     def wait(self):
         if self._server is not None:

@@ -1,0 +1,110 @@
+"""Operator HTTP endpoint: /metrics (Prometheus text format from
+utils.metrics.REGISTRY) and /healthz (service.health.HealthMonitor JSON).
+
+The reference has no observability surface at all (SURVEY §5.5 — logging
+only); this is the cheap operator-facing extension the TPU service ships:
+one stdlib ThreadingHTTPServer, no dependencies, curl-able:
+
+    curl localhost:9109/metrics
+    curl localhost:9109/healthz     # 200 healthy / 503 unhealthy
+
+Enabled by an `ops:` section in config.yaml (port, host) or by
+constructing OpsServer directly around any EngineService.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.logging import get_logger
+from ..utils.metrics import REGISTRY
+
+log = get_logger("ops")
+
+
+class OpsServer:
+    """HTTP server exposing /metrics and /healthz for one EngineService.
+
+    start() binds and serves on a daemon thread; port 0 picks a free port
+    (the bound port is in `self.port`)."""
+
+    def __init__(self, service=None, host: str = "127.0.0.1", port: int = 0,
+                 registry=REGISTRY):
+        self.service = service
+        self.host = host
+        self.port = port
+        self.registry = registry
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self.monitor = None
+        if service is not None:
+            from .health import HealthMonitor
+
+            self.monitor = HealthMonitor(service)
+
+    def start(self) -> "OpsServer":
+        ops = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route into our logger
+                log.debug("http %s", fmt % args)
+
+            def _send(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = ops.registry.render().encode()
+                        self._send(
+                            200, body, "text/plain; version=0.0.4"
+                        )
+                    elif self.path.split("?")[0] == "/healthz":
+                        if ops.monitor is None:
+                            self._send(
+                                200, b'{"healthy": true, "detail": '
+                                b'"no service attached"}\n',
+                                "application/json",
+                            )
+                            return
+                        health = ops.monitor.check()
+                        body = (
+                            json.dumps(health.as_dict(), default=str) + "\n"
+                        ).encode()
+                        self._send(
+                            200 if health.healthy else 503, body,
+                            "application/json",
+                        )
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except Exception:  # never kill the handler thread
+                    log.exception("ops endpoint error")
+                    try:
+                        self._send(500, b"internal error\n", "text/plain")
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ops-http", daemon=True
+        )
+        self._thread.start()
+        log.info("ops endpoint up on %s:%d (/metrics, /healthz)",
+                 self.host, self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

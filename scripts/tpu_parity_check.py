@@ -37,7 +37,7 @@ def run_parity(S=512, T=16, CAP=128, K=16, G=4, log=print) -> int:
 
     from gome_tpu.ops import default_block_s
 
-    block_s = default_block_s(S)
+    block_s = default_block_s(S, CAP)
     if block_s is None:
         log(f"S={S} has no valid compiled-kernel blocking "
             "(see gome_tpu.ops.default_block_s)")
@@ -65,20 +65,10 @@ def run_parity(S=512, T=16, CAP=128, K=16, G=4, log=print) -> int:
         b_pall, o_pall = pallas_batch_step(
             config, b_pall, ops, block_s=block_s, interpret=False
         )
-        for name in o_scan._fields:
-            a = np.asarray(jax.device_get(getattr(o_scan, name)))
-            b = np.asarray(jax.device_get(getattr(o_pall, name)))
-            if not np.array_equal(a, b):
-                bad = np.argwhere(a != b)[:5]
-                log(f"MISMATCH grid {g} StepOutput.{name} at {bad}")
-                return 1
-        for name in b_scan._fields:
-            a = np.asarray(jax.device_get(getattr(b_scan, name)))
-            b = np.asarray(jax.device_get(getattr(b_pall, name)))
-            if not np.array_equal(a, b):
-                bad = np.argwhere(a != b)[:5]
-                log(f"MISMATCH grid {g} BookState.{name} at {bad}")
-                return 1
+        if not _leaves_equal(o_scan, o_pall, f"grid {g} StepOutput", log):
+            return 1
+        if not _leaves_equal(b_scan, b_pall, f"grid {g} BookState", log):
+            return 1
         fills = int(np.asarray(jax.device_get(o_scan.n_fills)).sum())
         log(f"grid {g}: OK ({fills} fills)")
     log(f"PARITY OK: compiled pallas == scan on {G} grids "
@@ -86,9 +76,231 @@ def run_parity(S=512, T=16, CAP=128, K=16, G=4, log=print) -> int:
     return 0
 
 
+def _leaves_equal(pair_a, pair_b, what, log) -> bool:
+    import jax
+
+    for name in pair_a._fields:
+        a = np.asarray(jax.device_get(getattr(pair_a, name)))
+        b = np.asarray(jax.device_get(getattr(pair_b, name)))
+        if not np.array_equal(a, b):
+            bad = np.argwhere(a != b)[:5]
+            log(f"MISMATCH {what}.{name} at {bad}")
+            return False
+    return True
+
+
+def run_dense_parity(R=8, T=128, CAP=32, K=8, S=64, log=print) -> int:
+    """Compiled dense gather/scatter kernel (dense_kernel_step) vs the scan
+    dense path on deep time axes — the time-blocked VMEM kernel's block_t
+    loop is only exercised with T >> block_t."""
+    import jax
+    import jax.numpy as jnp
+
+    from gome_tpu.engine import BookConfig, init_books
+    from gome_tpu.engine.batch import dense_batch_step, dense_kernel_step
+    from gome_tpu.engine.book import DeviceOp
+    from gome_tpu.ops import default_block_s
+
+    if jax.default_backend() != "tpu":
+        log("SKIP dense: no TPU backend")
+        return 0
+    config = BookConfig(cap=CAP, max_fills=K, dtype=jnp.int32)
+    bs = default_block_s(R, CAP)
+    if bs is None:
+        log(f"dense: R={R} unblockable")
+        return 2
+    r = np.random.default_rng(11)
+    lane_ids = np.sort(r.choice(S, R, replace=False)).astype(np.int64)
+
+    def ops(seed):
+        q = np.random.default_rng(seed)
+        return DeviceOp(
+            action=q.choice([1, 1, 1, 2], size=(R, T)).astype(np.int32),
+            side=q.integers(0, 2, (R, T)).astype(np.int32),
+            is_market=(q.random((R, T)) < 0.1).astype(np.int32),
+            price=q.integers(995_000, 1_005_000, (R, T)).astype(np.int32),
+            volume=q.integers(1, 100, (R, T)).astype(np.int32),
+            oid=(np.arange(R * T).reshape(R, T) % 211 + 1).astype(np.int32),
+            uid=np.ones((R, T), np.int32),
+        )
+
+    b_scan = b_pall = init_books(config, S)
+    ids = jnp.asarray(lane_ids)
+    for g in range(2):
+        o = ops(100 + g)
+        b_scan, o_scan = dense_batch_step(config, b_scan, ids, o)
+        b_pall, o_pall = dense_kernel_step(config, b_pall, ids, o, bs)
+        if not _leaves_equal(o_scan, o_pall, f"dense grid {g} StepOutput", log):
+            return 1
+        if not _leaves_equal(b_scan, b_pall, f"dense grid {g} BookState", log):
+            return 1
+    log(f"dense PARITY OK: compiled dense kernel == scan dense path "
+        f"({R}x{T} deep rounds, block_t covered)")
+    return 0
+
+
+def run_edge_price_parity(S=128, T=8, CAP=32, K=8, log=print) -> int:
+    """Rebased int32 prices near the +/-2^30 envelope edges (what lane
+    rebasing feeds the kernel for BTC-magnitude symbols)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gome_tpu.engine import BookConfig, batch_step, init_books
+    from gome_tpu.engine.book import DeviceOp
+    from gome_tpu.ops import default_block_s, pallas_batch_step
+
+    if jax.default_backend() != "tpu":
+        log("SKIP edge: no TPU backend")
+        return 0
+    config = BookConfig(cap=CAP, max_fills=K, dtype=jnp.int32)
+    bs = default_block_s(S, CAP)
+    r = np.random.default_rng(13)
+    half = (1 << 30) - 1000
+
+    def ops(seed, base):
+        q = np.random.default_rng(seed)
+        return DeviceOp(
+            action=q.choice([1, 1, 1, 2], size=(S, T)).astype(np.int32),
+            side=q.integers(0, 2, (S, T)).astype(np.int32),
+            is_market=np.zeros((S, T), np.int32),
+            price=(base + q.integers(-900, 900, (S, T))).astype(np.int32),
+            volume=q.integers(1, 50, (S, T)).astype(np.int32),
+            oid=(np.arange(S * T).reshape(S, T) % 97 + 1).astype(np.int32),
+            uid=np.ones((S, T), np.int32),
+        )
+
+    b_scan = b_pall = init_books(config, S)
+    for g, base in enumerate((half, -half)):
+        o = ops(50 + g, base)
+        b_scan, o_scan = batch_step(config, b_scan, o)
+        b_pall, o_pall = pallas_batch_step(
+            config, b_pall, o, block_s=bs, interpret=False
+        )
+        if not _leaves_equal(o_scan, o_pall, f"edge grid {g} StepOutput", log):
+            return 1
+        if not _leaves_equal(b_scan, b_pall, f"edge grid {g} BookState", log):
+            return 1
+    log("edge PARITY OK: rebased prices at +/-2^30 envelope edges")
+    return 0
+
+
+def run_engine_escalation_parity(log=print) -> int:
+    """ENGINE-level differential on TPU with the compiled kernel: a
+    sweep-heavy stream that trips cap + fill-record budgets, so the
+    certified surface includes the escalation replay geometries
+    (cap/max_fills doublings) and the frame fast path's rollback — not
+    just the steady-state grid shape."""
+    import jax
+
+    from gome_tpu.engine import BatchEngine, BookConfig
+    from gome_tpu.oracle import OracleEngine
+    from gome_tpu.types import Order, Side
+
+    if jax.default_backend() != "tpu":
+        log("SKIP escalation: no TPU backend")
+        return 0
+    import jax.numpy as jnp
+
+    orders = [
+        Order(uuid="u", oid=str(i), symbol=f"s{i % 3}", side=Side.SALE,
+              price=100 + (i % 37), volume=1 + (i % 5))
+        for i in range(120)
+    ]
+    orders.append(
+        Order(uuid="u", oid="sweep", symbol="s0", side=Side.BUY, price=300,
+              volume=10_000)  # >> max_fills resting orders: escalates
+    )
+    eng = BatchEngine(
+        BookConfig(cap=8, max_fills=4, dtype=jnp.int32),
+        n_slots=8, max_t=8, kernel="pallas",
+    )
+    got = []
+    for i in range(0, len(orders), 40):
+        got.extend(
+            eng.process_columnar(orders[i : i + 40]).to_results()
+        )
+    oracle = OracleEngine()
+    want = [r for o in orders for r in oracle.process(o)]
+    if got != want:
+        log(f"MISMATCH escalation stream: {len(got)} vs {len(want)} events")
+        return 1
+    if eng.stats.cap_escalations < 1:
+        log("escalation: WARNING — stream did not escalate (geometry drift)")
+    eng.verify_books()
+    log(f"escalation PARITY OK: compiled kernel through cap/record "
+        f"escalations == oracle ({len(got)} events, "
+        f"{eng.stats.cap_escalations} escalations)")
+    return 0
+
+
+def run_fuzz_slice(cases=2, log=print) -> int:
+    """A small compiled-mode slice of the differential fuzzer's geometry
+    space (the three round-1 Mosaic crashes were all found by randomized
+    geometries; CI only runs interpret mode)."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        log("SKIP fuzz: no TPU backend")
+        return 0
+    import jax.numpy as jnp
+
+    from gome_tpu.engine import BatchEngine, BookConfig
+    from gome_tpu.oracle import OracleEngine
+    from gome_tpu.utils.streams import multi_symbol_stream
+
+    rng = np.random.default_rng(int(os.environ.get("BENCH_FUZZ_SEED", "5")))
+    for c in range(cases):
+        cap = int(rng.choice([8, 16]))
+        k = int(rng.choice([2, 4, 8]))
+        n_sym = int(rng.integers(2, 6))
+        orders = multi_symbol_stream(
+            n=150, n_symbols=n_sym, seed=int(rng.integers(1, 1 << 30)),
+            cancel_prob=0.2,
+        )
+        eng = BatchEngine(
+            BookConfig(cap=cap, max_fills=k, dtype=jnp.int32),
+            n_slots=8, max_t=8, kernel="pallas",
+        )
+        got = []
+        for i in range(0, len(orders), 50):
+            got.extend(
+                eng.process_columnar(orders[i : i + 50]).to_results()
+            )
+        oracle = OracleEngine()
+        want = [r for o in orders for r in oracle.process(o)]
+        if got != want:
+            log(f"MISMATCH fuzz case {c} (cap={cap} K={k} syms={n_sym})")
+            return 1
+        eng.verify_books()
+        log(f"fuzz case {c} OK (cap={cap} K={k} syms={n_sym}, "
+            f"{len(got)} events)")
+    log(f"fuzz PARITY OK: {cases} compiled-mode randomized geometries")
+    return 0
+
+
+def run_suite(S=128, T=8, CAP=256, K=16, G=2, log=print) -> int:
+    """The full certification the bench gates on: every code path _step can
+    select on TPU — full grids (incl. cancels + markets), dense deep
+    rounds (block_t), envelope-edge prices, escalation replays, and a
+    compiled-mode fuzz slice."""
+    for fn in (
+        lambda: run_parity(S=S, T=T, CAP=CAP, K=K, G=G, log=log),
+        lambda: run_dense_parity(log=log),
+        lambda: run_edge_price_parity(CAP=min(CAP, 32), log=log),
+        lambda: run_engine_escalation_parity(log=log),
+        lambda: run_fuzz_slice(log=log),
+    ):
+        rc = fn()
+        if rc == 1:
+            return 1
+    return 0
+
+
 def main():
-    args = [int(a) for a in sys.argv[1:6]]
+    args = [int(a) for a in sys.argv[1:6] if not a.startswith("--")]
     S, T, CAP, K, G = args + [512, 16, 128, 16, 4][len(args):]
+    if "--suite" in sys.argv or not args:
+        return run_suite(S=128, T=8, CAP=CAP, K=K, G=G)
     return run_parity(S, T, CAP, K, G)
 
 

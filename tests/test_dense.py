@@ -142,3 +142,26 @@ def test_dense_never_under_mesh():
     orders = multi_symbol_stream(n=60, n_symbols=3, seed=2, cancel_prob=0.1)
     got = _run_columnar(eng, orders, chunk=60)
     assert got == _oracle_events(orders)
+
+
+def test_grid_geometry_ratchets_are_grow_only():
+    """Compiled grid shapes must not oscillate across pow2 buckets as the
+    live-lane count / depth hovers at a boundary — one fresh XLA compile
+    costs more than thousands of frames of matching (the service bench's
+    mid-run-compile regression)."""
+    import numpy as np
+
+    from gome_tpu.engine import BatchEngine, BookConfig
+
+    eng = BatchEngine(BookConfig(cap=16, max_fills=4), n_slots=128, max_t=8)
+    shapes = []
+    for live_n in (9, 17, 9, 33, 9, 17):
+        use_dense, n_rows, _ = eng._grid_geometry(
+            np.arange(live_n, dtype=np.int64)
+        )
+        assert use_dense
+        shapes.append(n_rows)
+    assert shapes == [16, 32, 32, 64, 64, 64]  # never shrinks
+    # Ratchet capped below n_slots: growing past it falls back to full.
+    use_dense, n_rows, _ = eng._grid_geometry(np.arange(127, dtype=np.int64))
+    assert not use_dense and n_rows == eng.n_slots
