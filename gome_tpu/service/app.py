@@ -3,12 +3,14 @@
 The reference runs three processes wired by external RabbitMQ/Redis
 (README.md run instructions; SURVEY §1): gRPC server, order consumer, match
 consumer. Here the default deployment is one binary hosting all three
-components around the in-process (or file) bus; the same components can be
-run in separate processes against a shared `file` bus directory — the
-pre-pool race semantics then require the gateway and consumer to share the
-engine process (gateway in the consumer binary) or an external marker store,
-which is exactly the trade the reference makes by putting the pre-pool in
-Redis (nodepool.go:14-28).
+components around the in-process (or file) bus; the same components can
+also run in separate processes against a shared `file`/`amqp` bus — a
+`redis:` config section then puts the pre-pool markers in a
+Redis-compatible store (the built-in RESP client + engine.prepool.
+RespPrePool; persist/respserver.py is a standalone stand-in server), which
+is exactly the reference's own trade (nodepool.go:14-28) and gives the
+split topology reference race semantics (tested in
+tests/test_multiprocess.py::test_three_process_prepool_reference_topology).
 """
 
 from __future__ import annotations
