@@ -34,6 +34,7 @@ from .memory import MemoryQueue
 from .ordercodec import decode_orders_batch
 
 __all__ = [
+    "decode_message_orders",
     "decode_orders_batch",
     "Message",
     "Queue",
@@ -46,6 +47,21 @@ __all__ = [
     "encode_match_result",
     "decode_match_result",
 ]
+
+
+def decode_message_orders(body: bytes) -> list:
+    """Orders carried by one bus message, whichever wire kind it is: a
+    binary ORDER frame (colwire) holds a batch, a reference-shape JSON
+    document holds one. The single dispatch point shared by the consumer's
+    quarantine replay and the persistence layer's recovery scan — live
+    decoding and recovery must never diverge."""
+    from .colwire import decode_order_frame, is_frame
+
+    if is_frame(body):
+        from ..engine.frames import orders_from_frame
+
+        return orders_from_frame(decode_order_frame(body))
+    return decode_orders_batch([body])
 
 
 def make_bus(config) -> QueueBus:

@@ -230,20 +230,25 @@ class Persister:
         pre-pool; eliminating the race entirely would need a durable mark
         log (fsync per gateway mark — rejected as the wrong latency trade).
         """
-        from ..bus import decode_order
+        from ..bus import decode_message_orders
         from ..types import Action
+
+        def orders_in(m):
+            # A frame's whole batch shares the message offset (it consumes
+            # atomically), so the offset-based logic below is unchanged.
+            return decode_message_orders(m.body)
 
         oq = self.bus.order_queue
         tail = oq.read_from(cut, oq.end_offset() - cut)
         suppressible = set()  # keys of never-consumed ADDs
         tail_adds: list[tuple[int, tuple]] = []
         for m in tail:
-            order = decode_order(m.body)
-            if order.action is Action.ADD:
-                key = (order.symbol, order.uuid, order.oid)
-                tail_adds.append((m.offset, key))
-                if m.offset >= consumed_to:
-                    suppressible.add(key)
+            for order in orders_in(m):
+                if order.action is Action.ADD:
+                    key = (order.symbol, order.uuid, order.oid)
+                    tail_adds.append((m.offset, key))
+                    if m.offset >= consumed_to:
+                        suppressible.add(key)
         if not tail_adds:
             return len(tail)
         # Last committed action per suppressible key (recovery-only scan).
@@ -251,16 +256,21 @@ class Persister:
         pos = 0
         while pos < cut and suppressible:
             for m in oq.read_from(pos, min(4096, cut - pos)):
-                order = decode_order(m.body)
-                key = (order.symbol, order.uuid, order.oid)
-                if key in suppressible:
-                    last_committed[key] = order.action
+                for order in orders_in(m):
+                    key = (order.symbol, order.uuid, order.oid)
+                    if key in suppressible:
+                        last_committed[key] = order.action
                 pos = m.offset + 1
-        for offset, key in tail_adds:
-            if (
+        remark = [
+            key
+            for offset, key in tail_adds
+            if not (
                 offset >= consumed_to
                 and last_committed.get(key) is Action.DEL
-            ):
-                continue
-            self.engine.pre_pool.add(key)
+            )
+        ]
+        # One batched update: with a remote marker store this is a single
+        # pipelined round trip instead of one HSET per queued ADD (a tail
+        # of 256K-order frames would otherwise take minutes to re-mark).
+        self.engine.pre_pool.update(remark)
         return len(tail)

@@ -44,13 +44,30 @@ class EngineService:
             # (Redis-compatible) store under the reference's exact schema —
             # split gateway/consumer processes then share marker state the
             # way the reference's three processes do (nodepool.go:14-28).
+            # Like the amqp bus backend, an unreachable store must not stop
+            # the engine from booting (the reference config.yaml names
+            # local Redis/RabbitMQ that may not exist in this environment):
+            # warn loudly and keep the in-process pool.
             from ..engine.prepool import RespPrePool
-            from ..persist.resp import RespClient
+            from ..persist.resp import RespClient, RespError
 
             st = self.config.store
-            self.engine.pre_pool = RespPrePool(
-                RespClient(st.host, st.port, password=st.password or None)
-            )
+            try:
+                client = RespClient(
+                    st.host, st.port, password=st.password or None
+                )
+                # Validate the session up front (a reachable-but-unusable
+                # store, e.g. NOAUTH, must fall back at boot — not fail
+                # on the first hot-path HSET).
+                client.ping()
+                self.engine.pre_pool = RespPrePool(client)
+            except (OSError, RespError) as exc:
+                log.warning(
+                    "redis store %s:%d unusable (%s): pre-pool markers "
+                    "stay IN-PROCESS — split gateway/consumer deployments "
+                    "need the store up",
+                    st.host, st.port, exc,
+                )
         self.persist = persist  # gome_tpu.persist.Persister or None
         on_batch = None
         if persist is not None:

@@ -350,16 +350,11 @@ class OrderConsumer:
         events are ever dead-lettered because the match queue hiccuped."""
         msgs = self.bus.order_queue.poll_batch(self.batch_n, 0)
         processed = 0
-        from ..bus.colwire import decode_order_frame, is_frame
+        from ..bus import decode_message_orders
 
         for m in msgs:
             try:
-                if is_frame(m.body):
-                    from ..engine.frames import orders_from_frame
-
-                    orders = orders_from_frame(decode_order_frame(m.body))
-                else:
-                    orders = decode_orders_batch([m.body])
+                orders = decode_message_orders(m.body)
             except Exception:
                 # Undecodable message: nothing to salvage.
                 _poisoned.inc(1)

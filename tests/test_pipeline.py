@@ -322,3 +322,64 @@ def test_pipeline_mixed_json_and_frames():
     msgs = bus.match_queue.read_from(0, 1 << 20)
     assert [m.body for m in msgs] == sync_events
     _assert_books_equal(engine, sync_eng)
+
+
+def test_pipelined_soak_with_persist_crash_restore(tmp_path):
+    """The trickiest new interaction: cross-frame pipelining + the persist
+    layer's consistent-cut snapshots + crash recovery. A pipelined service
+    processes frames with snapshots riding on_batch; a crash (new service
+    over the same dirs) restores and replays; the end-to-end match stream
+    equals an uninterrupted unpipelined run byte-for-byte."""
+    from gome_tpu.config import Config, EngineConfig, PersistConfig, BusConfig
+    from gome_tpu.persist import Persister
+    from gome_tpu.service.app import EngineService
+
+    orders = multi_symbol_stream(n=1200, n_symbols=20, seed=41,
+                                 cancel_prob=0.2)
+    frames = _frames_for(orders, 150)
+
+    def feed(svc, payloads, first_frame=0):
+        for i, p in enumerate(payloads, start=first_frame):
+            # Gateway role: mark THEN publish (main.go:42-48 order).
+            for o in orders[i * 150 : i * 150 + 150]:
+                svc.engine.mark(o)
+            svc.bus.order_queue.publish(p)
+
+    # Uninterrupted reference run (no pipeline, memory bus).
+    ref = EngineService(
+        Config(engine=EngineConfig(cap=32, max_fills=8, n_slots=32, max_t=8))
+    )
+    feed(ref, frames)
+    ref.consumer.drain()
+    ref_events = [
+        m.body for m in ref.bus.match_queue.read_from(0, 1 << 20)
+    ]
+
+    def make_svc():
+        cfg = Config(
+            engine=EngineConfig(cap=32, max_fills=8, n_slots=32, max_t=8,
+                                pipeline_depth=3),
+            bus=BusConfig(backend="file", dir=str(tmp_path / "bus")),
+            # every_n_batches=1: in pipelined mode the persist hook fires
+            # once per pipeline-empty boundary (a whole drain is ONE
+            # consistent cut), so any higher cadence may never snapshot.
+            persist=PersistConfig(enabled=True, dir=str(tmp_path / "snap"),
+                                  every_n_batches=1),
+        )
+        return EngineService(cfg, persist=Persister(cfg.persist))
+
+    svc = make_svc()
+    svc.persist.restore_latest()
+    feed(svc, frames[:5])
+    svc.consumer.drain()  # snapshots fire at pipeline-empty cuts
+    feed(svc, frames[5:], first_frame=5)
+    for _ in range(3):  # partially drain, leaving work + in-flight state
+        svc.consumer.run_once()
+
+    # Crash: fresh process over the same dirs.
+    svc2 = make_svc()
+    assert svc2.persist.restore_latest()
+    svc2.consumer.drain()
+    got = [m.body for m in svc2.bus.match_queue.read_from(0, 1 << 20)]
+    assert got == ref_events
+    svc2.engine.batch.verify_books()
