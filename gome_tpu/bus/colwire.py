@@ -140,6 +140,43 @@ def encode_order_frame(
     return b"".join(parts)
 
 
+def encode_orders(orders) -> bytes:
+    """Convenience: a list of Order objects -> one ORDER frame (what a
+    batching gateway produces; shared by tests, the fuzzer, and examples)."""
+    n = len(orders)
+    syms: list[str] = []
+    uuids: list[str] = []
+    sym_ix: dict[str, int] = {}
+    uuid_ix: dict[str, int] = {}
+    sym_idx = np.empty(n, np.uint32)
+    uuid_idx = np.empty(n, np.uint32)
+    action = np.empty(n, np.uint8)
+    side = np.empty(n, np.uint8)
+    kind = np.empty(n, np.uint8)
+    price = np.empty(n, np.int64)
+    volume = np.empty(n, np.int64)
+    oids = []
+    for i, o in enumerate(orders):
+        action[i] = int(o.action)
+        side[i] = int(o.side)
+        kind[i] = int(o.order_type)
+        price[i] = o.price
+        volume[i] = o.volume
+        if o.symbol not in sym_ix:
+            sym_ix[o.symbol] = len(syms)
+            syms.append(o.symbol)
+        sym_idx[i] = sym_ix[o.symbol]
+        if o.uuid not in uuid_ix:
+            uuid_ix[o.uuid] = len(uuids)
+            uuids.append(o.uuid)
+        uuid_idx[i] = uuid_ix[o.uuid]
+        oids.append(o.oid)
+    return encode_order_frame(
+        n, action, side, kind, price, volume, syms, sym_idx, uuids,
+        uuid_idx, oids,
+    )
+
+
 def decode_order_frame(payload: bytes) -> dict:
     """ORDER frame -> dict of numpy columns + string dictionaries:
     {action,side,kind,price,volume: np arrays; symbols: list[str],

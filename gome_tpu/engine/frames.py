@@ -477,9 +477,15 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
                 eng.config, outs, e_fills, e_cancels
             )
             meta["_n_rows"] = n_rows
-            items.append(
-                (meta, (t_grid, eng.config.max_fills), compact, n_ops)
-            )
+            # The record axis K comes from the ARRAY, never from
+            # config.max_fills: with cap < max_fills the step's record
+            # slice clamps to cap (step.py `rec`), so the decode's flat
+            # src arithmetic — and the truncation check in resolve_frame —
+            # must use the K the records were actually emitted with
+            # (fuzz-found: seed 9087, cap=4 K=8 mis-decoded fills and
+            # would have silently dropped records of >K-fill ops).
+            k_rec = int(outs.fill_qty.shape[-1])
+            items.append((meta, (t_grid, k_rec), compact, n_ops))
         eng.books = books
         for _, _, compact, _ in items:
             for leaf in jax.tree.leaves(compact):
@@ -505,7 +511,10 @@ def resolve_frame(eng: BatchEngine, pend: PendingFrame):
         totals = fetched[0]
         if (
             int(totals[2]) > 0  # book overflow: state is wrong
-            or int(totals[3]) > eng.config.max_fills  # truncated records
+            # Records truncated: an op produced more fills than the K the
+            # record arrays were emitted with (shape[1] — the ARRAY axis,
+            # which cap may clamp below config.max_fills).
+            or int(totals[3]) > shape[1]
             or int(totals[0]) > len(fetched[1]["src"])  # buffer overflow
             or int(totals[1]) > len(fetched[2]["src"])
         ):

@@ -1,8 +1,9 @@
 """Differential fuzz: device engine vs the pure-Python oracle on randomized
 streams under adversarial engine geometries (tiny caps -> constant cap
 escalation, tiny max_fills -> record escalations, max_t=1 -> per-op grids,
-lane growth, int32 rebasing at extreme price bases, columnar + object
-decode paths).
+lane growth, int32 rebasing at extreme price bases, and all three decode
+paths: object, columnar, and ORDER frames through MatchEngine admission +
+the cross-frame device pipeline).
 
     python scripts/fuzz.py [n_cases] [seed0] [--tpu]
 
@@ -56,7 +57,10 @@ def run_case(seed: int) -> str:
     max_t = int(rng.choice([1, 3, 16]))
     n_slots = int(rng.choice([1, 2, 8, 16]))
     dtype = jnp.int32 if rng.random() < 0.5 else jnp.int64
-    use_columnar = bool(rng.random() < 0.5)
+    # object: per-order path; columnar: vectorized decode; frame: ORDER
+    # frames through MatchEngine admission + the cross-frame device
+    # pipeline (random depth) — the native host ops' differential target.
+    mode = str(rng.choice(["object", "columnar", "frame"]))
     n_symbols = int(rng.choice([1, 3, 7]))
     base_price = int(
         rng.choice([100, 10_000_000, 10_000_000_000_000 if dtype == jnp.int32 else 100_000])
@@ -111,17 +115,40 @@ def run_case(seed: int) -> str:
     kernel = os.environ.get("GOME_FUZZ_KERNEL", "scan")
     if kernel not in ("scan", "pallas"):
         raise ValueError(f"GOME_FUZZ_KERNEL must be scan|pallas, got {kernel!r}")
-    engine = BatchEngine(
-        BookConfig(cap=cap, max_fills=max_fills, dtype=dtype),
-        n_slots=n_slots, max_t=max_t, kernel=kernel,
-    )
-    got = []
-    for i in range(0, len(orders), chunk):
-        part = orders[i : i + chunk]
-        if use_columnar:
-            got.extend(engine.process_columnar(part).to_results())
-        else:
-            got.extend(engine.process(part))
+    depth = 0
+    if mode == "frame":
+        from gome_tpu.bus.colwire import decode_order_frame, encode_orders
+        from gome_tpu.engine.orchestrator import MatchEngine
+        from gome_tpu.engine.pipeline import FramePipeline
+
+        depth = int(rng.choice([1, 2, 3]))
+        meng = MatchEngine(
+            config=BookConfig(cap=cap, max_fills=max_fills, dtype=dtype),
+            n_slots=n_slots, max_t=max_t, kernel=kernel,
+        )
+        engine = meng.batch
+        for o in orders:
+            meng.mark(o)
+        pipe = FramePipeline(meng, depth=depth)
+        got = []
+        for i in range(0, len(orders), chunk):
+            cols = decode_order_frame(encode_orders(orders[i : i + chunk]))
+            for _tok, batch in pipe.feed(cols):
+                got.extend(batch.to_results())
+        for _tok, batch in pipe.flush():
+            got.extend(batch.to_results())
+    else:
+        engine = BatchEngine(
+            BookConfig(cap=cap, max_fills=max_fills, dtype=dtype),
+            n_slots=n_slots, max_t=max_t, kernel=kernel,
+        )
+        got = []
+        for i in range(0, len(orders), chunk):
+            part = orders[i : i + chunk]
+            if mode == "columnar":
+                got.extend(engine.process_columnar(part).to_results())
+            else:
+                got.extend(engine.process(part))
     from gome_tpu.ops import default_block_s, pallas_available
 
     effective = (
@@ -133,7 +160,8 @@ def run_case(seed: int) -> str:
     )
     desc = (
         f"seed={seed} cap={cap} K={max_fills} max_t={max_t} slots={n_slots} "
-        f"dtype={np.dtype(dtype).name} columnar={use_columnar} "
+        f"dtype={np.dtype(dtype).name} mode={mode}"
+        f"{f'(depth={depth})' if depth else ''} "
         f"kernel={effective} base={base_price} band={band} n={n_orders} "
         f"chunk={chunk}"
     )

@@ -16,39 +16,9 @@ from gome_tpu.utils.streams import multi_symbol_stream
 
 def orders_to_frame(orders):
     """Encode a list of Orders as one ORDER frame (what a batching gateway
-    or the columnar load client produces)."""
-    n = len(orders)
-    syms, uuids = [], []
-    sym_ix, uuid_ix = {}, {}
-    sym_idx = np.empty(n, np.uint32)
-    uuid_idx = np.empty(n, np.uint32)
-    cols = {
-        "action": np.empty(n, np.uint8),
-        "side": np.empty(n, np.uint8),
-        "kind": np.empty(n, np.uint8),
-        "price": np.empty(n, np.int64),
-        "volume": np.empty(n, np.int64),
-    }
-    oids = []
-    for i, o in enumerate(orders):
-        cols["action"][i] = int(o.action)
-        cols["side"][i] = int(o.side)
-        cols["kind"][i] = int(o.order_type)
-        cols["price"][i] = o.price
-        cols["volume"][i] = o.volume
-        if o.symbol not in sym_ix:
-            sym_ix[o.symbol] = len(syms)
-            syms.append(o.symbol)
-        sym_idx[i] = sym_ix[o.symbol]
-        if o.uuid not in uuid_ix:
-            uuid_ix[o.uuid] = len(uuids)
-            uuids.append(o.uuid)
-        uuid_idx[i] = uuid_ix[o.uuid]
-        oids.append(o.oid)
-    return colwire.encode_order_frame(
-        n, cols["action"], cols["side"], cols["kind"], cols["price"],
-        cols["volume"], syms, sym_idx, uuids, uuid_idx, oids,
-    )
+    or the columnar load client produces) — the library implementation,
+    re-exported under the name older tests import."""
+    return colwire.encode_orders(orders)
 
 
 def run_frames(eng, orders, chunk, fast=False):
@@ -305,3 +275,36 @@ def test_order_frame_codec_edge_cases():
     assert cols["uuids"][cols["uuid_idx"][0]] == o.uuid
     assert cols["oids"][0].decode() == o.oid
     assert cols["price"][0] == 123 and cols["volume"][0] == 7
+
+
+def test_fast_path_cap_below_max_fills():
+    """cap < max_fills clamps the step's record axis K to cap (step.py's
+    `rec` slice) — the fast compact path must decode with the ARRAY K and
+    escalate when an op's fills exceed it, not config.max_fills
+    (fuzz-found: mis-decoded fill positions and silently truncated
+    records). Exercised per-frame against the oracle."""
+    import jax.numpy as jnp
+
+    orders = []
+    for i in range(12):
+        orders.append(
+            Order(uuid="u", oid=f"r{i}", symbol="s", side=Side.SALE,
+                  price=100 + i, volume=2)
+        )
+    # Sweeps crossing more than cap resting orders: records must escalate
+    # (n_fills > K=cap) and the decoded events must still be exact.
+    orders.append(
+        Order(uuid="u", oid="sweep", symbol="s", side=Side.BUY, price=200,
+              volume=11)
+    )
+    orders += [
+        Order(uuid="u", oid=f"p{i}", symbol="s2", side=Side(int(i % 2)),
+              price=150 + (i % 2), volume=3)
+        for i in range(8)
+    ]
+    eng = BatchEngine(
+        BookConfig(cap=4, max_fills=8, dtype=jnp.int32), n_slots=2, max_t=8
+    )
+    got = run_frames(eng, orders, 7, fast=True)
+    assert got == _oracle(orders)
+    eng.verify_books()
