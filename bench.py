@@ -360,29 +360,40 @@ def service_main():
     rng = np.random.default_rng(7)
     symbols = [f"sym{i}" for i in range(S)]
 
-    # Two warmup frames: frame geometry (grid-2 packed counts, compaction
-    # pow2 classes) only stabilizes after the books reach steady state, and
-    # every distinct shape is a tens-of-seconds AOT compile on the tunnel —
-    # all of it must happen off the clock. Chunk by min(FRAME, N) so small
-    # SVC_ORDERS runs still produce distinct warmup + timed frames.
+    # Warm until the compiled shapes stabilize: frame geometry (grid-2
+    # packed rows/depth ratchets, compaction buffer classes) evolves as
+    # the books reach steady state, and every distinct shape is a
+    # trace+compile (tens of seconds AOT on the tunnel, ~1s of host CPU
+    # re-trace even cache-hit) — none of it belongs inside the timed
+    # region, exactly as a production deployment pre-warms its known
+    # geometry (BatchEngine.prewarm_geometry). A warmup frame that leaves
+    # every geometry ratchet unchanged means the next frame replays
+    # already-compiled programs; two such frames in a row ends warmup
+    # (min 2, max 8 warm frames; count reported on stderr).
     FRAME = min(FRAME, N)
-    N_WARM = 2
     oid0 = 1
+    n_warm = 0
+    stable = 0
+    while n_warm < 8 and (n_warm < 2 or stable < 2):
+        cols = _svc_columns(rng, FRAME, S, oid0)
+        oid0 += FRAME
+        geo = engine.batch.geometry_floors()
+        _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
+        consumer.drain()
+        stable = stable + 1 if engine.batch.geometry_floors() == geo else 0
+        n_warm += 1
+
     frames_cols = []
-    for start in range(0, N_WARM * FRAME + N, FRAME):
-        n = min(FRAME, N_WARM * FRAME + N - start)
+    for start in range(0, N, FRAME):
+        n = min(FRAME, N - start)
         frames_cols.append(_svc_columns(rng, n, S, oid0))
         oid0 += n
-
-    for cols in frames_cols[:N_WARM]:
-        _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
-    consumer.drain()
     engine_frames.FETCH_SECONDS = 0.0
     ev_skip = bus.match_queue.end_offset()  # warmup frames' events
 
     # Gateway phase (timed): encode + mark + publish every frame.
     t0 = time.perf_counter()
-    for cols in frames_cols[N_WARM:]:
+    for cols in frames_cols:
         _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
     t_gateway = time.perf_counter() - t0
 
@@ -422,7 +433,8 @@ def service_main():
     host_s = max(elapsed - fetch_s, 1e-9)
     st = engine.stats
     print(
-        f"# orders={n_done} events={n_events} gateway={t_gateway:.3f}s "
+        f"# orders={n_done} events={n_events} warm_frames={n_warm} "
+        f"gateway={t_gateway:.3f}s "
         f"consumer={t_consumer:.3f}s fetch_blocked={fetch_s:.3f}s "
         f"(dev-tunnel link) | ex-fetch {n_done / host_s / 1e6:.2f}M "
         f"orders/sec | consumer-only {n_done / max(t_consumer, 1e-9) / 1e6:.2f}M "

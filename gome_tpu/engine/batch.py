@@ -309,6 +309,13 @@ class BatchEngine:
         # compiled grid shapes must not oscillate across pow2 buckets.
         self._dense_rows_floor = 8
         self._dense_t_floor = 8
+        # Compaction-buffer ratchets (frames._compact_sizes): grow-only
+        # fetch-buffer sizes. Both rise to the largest pow2 class any
+        # frame has needed; the fills floor additionally grows when a
+        # frame's fill count overflows its buffer (the exact-path
+        # fallback keeps that safe).
+        self._fills_buf_floor = 0
+        self._cancels_buf_floor = 0
         if mesh is not None:
             # Every place n_slots can be set (init, growth, restore) must
             # produce a mesh multiple; enforcing the two static bounds here
@@ -409,6 +416,51 @@ class BatchEngine:
             ):
                 drop[i] = True
         return drop
+
+    def prewarm_geometry(
+        self,
+        rows_floor: int | None = None,
+        t_floor: int | None = None,
+        fills_buf: int | None = None,
+        cancels_buf: int | None = None,
+    ) -> None:
+        """Pre-set the grow-only shape ratchets to known steady-state
+        values (each rounds up to a power of two; existing floors never
+        shrink). Every distinct compiled shape costs a trace+compile the
+        first time it appears; a deployment that knows its flow's geometry
+        (from a previous run or a staging soak) pre-warms here so every
+        shape compiles during warmup instead of mid-traffic. Purely a
+        performance knob — untouched ratchets grow on demand exactly as
+        before."""
+        if rows_floor is not None:
+            self._dense_rows_floor = max(
+                self._dense_rows_floor, _next_pow2(max(rows_floor, 8))
+            )
+        if t_floor is not None:
+            self._dense_t_floor = max(
+                self._dense_t_floor, _next_pow2(max(t_floor, 8))
+            )
+        if fills_buf is not None:
+            self._fills_buf_floor = max(
+                self._fills_buf_floor, _next_pow2(max(fills_buf, 64))
+            )
+        if cancels_buf is not None:
+            self._cancels_buf_floor = max(
+                self._cancels_buf_floor, _next_pow2(max(cancels_buf, 64))
+            )
+
+    def geometry_floors(self) -> dict:
+        """The current grow-only shape ratchets (see prewarm_geometry) —
+        what a warmup loop watches to decide the flow's compiled shapes
+        have stabilized, and what a deployment records to pre-warm the
+        next process."""
+        return dict(
+            rows_floor=self._dense_rows_floor,
+            t_floor=self._dense_t_floor,
+            fills_buf=self._fills_buf_floor,
+            cancels_buf=self._cancels_buf_floor,
+            cap=self.config.cap,
+        )
 
     def _grid_geometry(self, live: np.ndarray):
         """Grid geometry decision, shared by the object packer and the

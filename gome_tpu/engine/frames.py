@@ -472,7 +472,8 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
             eng.stats.device_calls += 1
             n_rows, t_grid = ops.action.shape
             n_ops = len(meta["row"])
-            e_fills, e_cancels = _compact_sizes(eng, n_ops)
+            n_dels = int((meta["action"] == ACTION_DEL).sum())
+            e_fills, e_cancels = _compact_sizes(eng, n_ops, n_dels)
             compact = compact_step_outputs(
                 eng.config, outs, e_fills, e_cancels
             )
@@ -509,13 +510,27 @@ def resolve_frame(eng: BatchEngine, pend: PendingFrame):
         fetched = jax.device_get(compact)
         FETCH_SECONDS += time.perf_counter() - t0
         totals = fetched[0]
+        # A fills-buffer overflow ratchets the grow-only floor BEFORE the
+        # exact fallback, so the next frame's buffer fits (one slow frame
+        # per ratchet step, not a recurring tax). totals[0] is the TRUE
+        # fill count (the compaction drops writes past the buffer but
+        # sums the full mask), so one step reaches the right size.
+        n_fills_seen = int(totals[0])
+        tripped = False
+        if n_fills_seen > len(fetched[1]["src"]):
+            eng._fills_buf_floor = max(
+                eng._fills_buf_floor, _next_pow2(n_fills_seen)
+            )
+            tripped = True
         if (
-            int(totals[2]) > 0  # book overflow: state is wrong
+            tripped
+            or int(totals[2]) > 0  # book overflow: state is wrong
             # Records truncated: an op produced more fills than the K the
             # record arrays were emitted with (shape[1] — the ARRAY axis,
             # which cap may clamp below config.max_fills).
             or int(totals[3]) > shape[1]
-            or int(totals[0]) > len(fetched[1]["src"])  # buffer overflow
+            # Unreachable by construction (cancels <= the grid's DEL
+            # count, which sizes the buffer) — defensive only.
             or int(totals[1]) > len(fetched[2]["src"])
         ):
             raise _NeedExact()
@@ -547,16 +562,36 @@ def apply_frame_fast(eng: BatchEngine, cols: dict):
         raise
 
 
-def _compact_sizes(eng, n_ops: int) -> tuple[int, int]:
-    """Compaction buffer sizes for a grid of n_ops packed ops. MUST be a
-    pure function of n_ops's pow2 class: every distinct size is a fresh
-    kernel compile, and on a tunneled dev TPU one AOT compile costs tens of
-    seconds — far more than the transfer waste of a generous buffer (the
-    fetch-time accounting absorbs that). Fills get 2x headroom (an op can
-    produce up to K fills; a frame averaging >2 fills/op falls back to the
-    exact path); cancels can never exceed n_ops."""
-    base = _next_pow2(max(n_ops, 64))
-    return 2 * base, base
+def _compact_sizes(eng, n_ops: int, n_dels: int) -> tuple[int, int]:
+    """Compaction buffer sizes for a grid of n_ops packed ops (n_dels of
+    them DELs). Sizes MUST be pow2-bucketed: every distinct size is a
+    fresh kernel compile. But the buffers are also the frame's device->
+    host transfer, and on a tunneled dev TPU that link is the end-to-end
+    ceiling — so they start TIGHT and ratchet up instead of paying 2x+
+    headroom forever:
+
+      fills   — next_pow2(n_ops) (<=1 fill/op average) or the engine's
+                grow-only floor, whichever is larger;
+      cancels — next_pow2 of the grid's actual DEL count (the exact upper
+                bound for its cancel events; a pure-ADD stream fetches a
+                64-slot stub instead of an n_ops-sized buffer of zeros).
+
+    Both sizes are themselves grow-only ratchets: a frame that lands in a
+    larger pow2 class raises the floor, so later smaller frames reuse the
+    same compiled shape instead of oscillating across classes (each
+    distinct (fills, cancels) pair is a fresh compile — data-dependent
+    sizes would recompile whenever a frame's DEL count straddled a pow2
+    boundary). A frame whose FILL count overflows its buffer
+    transactionally re-runs on the exact path (resolve_frame) AND raises
+    the floor, so that costs one slow frame per ratchet step, not a
+    recurring tax; cancel events can never overflow (cancels <= n_dels by
+    construction, step.py cancel_found). Deployments that know their flow
+    pre-warm the floors (BatchEngine.prewarm_geometry)."""
+    fills = max(_next_pow2(max(n_ops, 64)), eng._fills_buf_floor)
+    cancels = max(_next_pow2(max(n_dels, 64)), eng._cancels_buf_floor)
+    eng._fills_buf_floor = fills
+    eng._cancels_buf_floor = cancels
+    return fills, cancels
 
 
 class _NeedExact(Exception):

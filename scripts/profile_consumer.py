@@ -1,0 +1,96 @@
+"""Profile the consumer hot path CPU at the service-bench shape (dev tool).
+
+Replicates bench.py service_main's setup, then cProfiles the timed
+consumer drain so the per-stage CPU cost is visible without tunnel noise
+(process_time is still reported; cProfile overhead inflates everything
+uniformly)."""
+
+import cProfile
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import bench
+from bench import _enable_jax_cache, _svc_columns, _svc_gateway_step
+
+_enable_jax_cache()
+if os.environ.get("PROF_PLATFORM"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["PROF_PLATFORM"])
+
+import jax.numpy as jnp
+
+from gome_tpu.bus import MemoryQueue, QueueBus
+from gome_tpu.engine import BookConfig
+from gome_tpu.engine import frames as engine_frames
+from gome_tpu.engine.orchestrator import MatchEngine
+from gome_tpu.service.consumer import OrderConsumer
+
+N = int(os.environ.get("SVC_ORDERS", 524_288))
+FRAME = int(os.environ.get("SVC_FRAME", 262_144))
+S = int(os.environ.get("SVC_SYMBOLS", 10_240))
+CAP = int(os.environ.get("SVC_CAP", 256))
+PIPE = int(os.environ.get("SVC_PIPELINE", 2))
+
+engine = MatchEngine(
+    config=BookConfig(cap=CAP, max_fills=16, dtype=jnp.int32),
+    n_slots=S, max_t=32, kernel="pallas",
+)
+bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+consumer = OrderConsumer(
+    engine, bus, batch_n=1, batch_wait_s=0, match_wire="frame",
+    pipeline_depth=PIPE,
+)
+
+rng = np.random.default_rng(7)
+symbols = [f"sym{i}" for i in range(S)]
+FRAME = min(FRAME, N)
+oid0 = 1
+# Same warm-until-stable loop as bench.py service_main: profile only
+# steady-state frames (a frame that grows a geometry ratchet re-traces,
+# which the bench also keeps off the clock).
+n_warm = 0
+stable = 0
+while n_warm < 8 and (n_warm < 2 or stable < 2):
+    cols = _svc_columns(rng, FRAME, S, oid0)
+    oid0 += FRAME
+    geo = engine.batch.geometry_floors()
+    _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
+    consumer.drain()
+    stable = stable + 1 if engine.batch.geometry_floors() == geo else 0
+    n_warm += 1
+print(f"warm_frames={n_warm}", file=sys.stderr)
+
+frames_cols = []
+for start in range(0, N, FRAME):
+    n = min(FRAME, N - start)
+    frames_cols.append(_svc_columns(rng, n, S, oid0))
+    oid0 += n
+engine_frames.FETCH_SECONDS = 0.0
+
+for cols in frames_cols:
+    _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
+
+prof = cProfile.Profile()
+t0 = time.perf_counter()
+c0 = time.process_time()
+prof.enable()
+n_done = consumer.drain()
+prof.disable()
+cpu = time.process_time() - c0
+wall = time.perf_counter() - t0
+print(
+    f"orders={n_done} wall={wall:.3f}s cpu={cpu:.3f}s "
+    f"fetch={engine_frames.FETCH_SECONDS:.3f}s "
+    f"-> {n_done / cpu / 1e6:.2f}M orders/sec/core ({cpu / n_done * 1e6:.3f} us/order)",
+    file=sys.stderr,
+)
+st = pstats.Stats(prof, stream=sys.stderr)
+st.sort_stats("cumulative").print_stats(30)
+st.sort_stats("tottime").print_stats(30)
