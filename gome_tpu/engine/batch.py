@@ -330,7 +330,12 @@ class BatchEngine:
         self.books = self._place(init_books(config, n_slots))
         from .nativehost import make_interner
 
+        from ..utils.cache import IdentityCache
+
         self.symbols = Interner()  # symbol -> lane id + 1 offset handled below
+        # symbol-dictionary object -> (lane-id array, max lane); hits are
+        # revalidated against n_slots (frames._lane_map).
+        self._lane_map_cache = IdentityCache()
         # oids are the one per-order-unique string column — interned in C++
         # when the toolchain allows (nativehost; ~10x the dict loop).
         self.oids = make_interner()
@@ -1092,6 +1097,7 @@ class BatchEngine:
         from .nativehost import make_interner
 
         self.symbols = Interner.from_list(list(state["symbols"]))
+        self._lane_map_cache.clear()  # lane ids come from the new interner
         self.oids = make_interner(from_list=list(state["oids"]))
         self.uids = Interner.from_list(list(state["uids"]))
         self._rebase = jnp.dtype(self.config.dtype).itemsize <= 4

@@ -89,6 +89,13 @@ class DeviceOp(NamedTuple):
     uid: jax.Array  # dtype interned user id
 
 
+#: DeviceOp fields carried as int32 regardless of the book value dtype.
+#: Grid packers (the numpy path in engine.frames and the native
+#: nativehost.pack_grid) share this rule so both produce identically
+#: typed DeviceOp grids.
+GRID_I32_FIELDS = ("action", "side", "is_market")
+
+
 class StepOutput(NamedTuple):
     """Fixed-shape per-op result — everything the host needs to reconstruct
     the reference's MatchResult event stream (SURVEY §3.4) for this op.

@@ -53,13 +53,38 @@ FETCH_SECONDS = 0.0
 
 from ..types import Action, OrderType
 from .batch import BatchEngine, _next_pow2, splice_outs
-from .book import DeviceOp
+from .book import GRID_I32_FIELDS, DeviceOp
 from .step import ACTION_ADD, LOT_MAX32
 
 ACTION_DEL = int(Action.DEL)
 MARKET = int(OrderType.MARKET)
 
-_GRID_FIELDS = ("action", "side", "is_market", "price", "volume", "oid", "uid")
+_GRID_FIELDS = DeviceOp._fields  # one canonical field list + order
+
+
+def _lane_map(eng: BatchEngine, symbols) -> np.ndarray:
+    """symbol-dictionary -> lane-id array, cached by dictionary identity.
+
+    The wire decoder (bus.colwire) returns the SAME list object for a
+    dictionary region it has seen before, so a stable symbol universe
+    resolves its per-unique interner walk once, not once per frame. Lane
+    ids are permanent (the interner is grow-only), BUT a cached map is
+    only usable while every lane fits the CURRENT book stack: _lane()'s
+    side effect is auto-growing n_slots, and a transactional rollback
+    (_restore after a failed/overflowed frame) shrinks n_slots back — a
+    blind cache hit on the retry would skip the re-growth and index past
+    the restored books. Hence the max-lane revalidation; a stale hit
+    recomputes, re-growing exactly as the first attempt did. The cache
+    resets when the engine's interners are replaced (import_state)."""
+    ent = eng._lane_map_cache.get(symbols)
+    if ent is not None and ent[1] < eng.n_slots:
+        return ent[0]
+    lane_of_sym = np.empty(len(symbols), np.int64)
+    for i, s in enumerate(symbols):
+        lane_of_sym[i] = eng._lane(s)  # may auto-grow the book stack
+    max_lane = int(lane_of_sym.max()) if len(lane_of_sym) else -1
+    eng._lane_map_cache.put(symbols, (lane_of_sym, max_lane))
+    return lane_of_sym
 
 
 def intern_column(interner, uniques) -> np.ndarray:
@@ -82,9 +107,7 @@ def _frame_arrays(eng: BatchEngine, cols: dict) -> dict:
     price = np.ascontiguousarray(cols["price"], np.int64)
     volume = np.ascontiguousarray(cols["volume"], np.int64)
 
-    lane_of_sym = np.empty(len(cols["symbols"]), np.int64)
-    for i, s in enumerate(cols["symbols"]):
-        lane_of_sym[i] = eng._lane(s)  # may auto-grow the book stack
+    lane_of_sym = _lane_map(eng, cols["symbols"])
     lanes = lane_of_sym[cols["symbol_idx"]]
 
     uid_of = intern_column(eng.uids, cols["uuids"])
@@ -190,13 +213,27 @@ def pack_frame_grids(eng: BatchEngine, a: dict) -> list[tuple]:
         else:
             rows = lanes
             t_grid = eng.max_t
-        packed = active & (remaining_t < t_grid)
 
+        from . import nativehost
+
+        if nativehost.available():
+            # Selection + all 7 grid scatters + the 11 meta extractions in
+            # ONE native pass (the numpy form below is ~20 separate
+            # mask/scatter passes over frame-sized arrays).
+            grid, meta = nativehost.pack_grid(
+                a, rows, t_off, t_grid, n_rows, eng.config.dtype,
+                MARKET, ACTION_ADD,
+            )
+            grids.append((DeviceOp(**grid), meta, lane_ids))
+            t_off += t_grid
+            continue
+
+        packed = active & (remaining_t < t_grid)
         grid = {
             name: np.zeros(
                 (n_rows, t_grid),
                 np.int32
-                if name in ("action", "side", "is_market")
+                if name in GRID_I32_FIELDS
                 else np.dtype(eng.config.dtype),
             )
             for name in _GRID_FIELDS
@@ -661,7 +698,21 @@ def _prepare_bases_vec(eng, lanes, action, kind, price) -> np.ndarray:
             hi = np.full(eng.n_slots, np.iinfo(np.int64).min)
             np.minimum.at(lo, al, ap)
             np.maximum.at(hi, al, ap)
-            for lane in uniq.tolist():
+            # Vectorized widen for lanes that only need their envelope
+            # stretched (base already set, no recenter): the Python
+            # _admit_lane_range loop is ~3 us/lane and steady flows admit
+            # thousands of new per-lane extremes per frame while their
+            # envelopes converge. Seeding and recentering stay on the
+            # exact scalar path (rare).
+            b = eng.price_base[uniq]
+            easy = eng._base_set[uniq] & (
+                np.maximum(np.abs(lo[uniq] - b), np.abs(hi[uniq] - b))
+                <= eng.REBASE_LIMIT
+            )
+            ez = uniq[easy]
+            eng._env_lo[ez] = np.minimum(eng._env_lo[ez], lo[ez])
+            eng._env_hi[ez] = np.maximum(eng._env_hi[ez], hi[ez])
+            for lane in uniq[~easy].tolist():
                 eng._admit_lane_range(int(lane), int(lo[lane]), int(hi[lane]))
     dels = action == ACTION_DEL
     if dels.any():

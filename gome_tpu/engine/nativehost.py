@@ -88,6 +88,15 @@ def load():
     lib.go_occurrences.argtypes = [
         _p_i64, ctypes.c_void_p, _i64, _i64, _p_i64,
     ]
+    lib.go_pack_grid.restype = _i64
+    lib.go_pack_grid.argtypes = (
+        [_i64, _p_i64, _p_i64, _p_i64, _i64, _i64, _i64]  # n..n_rows
+        + [_p_i64] * 8  # action..bases
+        + [_i64, _i64]  # market_val, add_val
+        + [ctypes.c_void_p] * 3  # g_action, g_side, g_market (i32)
+        + [ctypes.c_void_p] * 4 + [_i64]  # value grids + itemsize
+        + [_p_i64] * 11  # meta outputs
+    )
     lib.go_decode_compact.restype = _i64
     lib.go_decode_compact.argtypes = (
         [_i64] * 6
@@ -163,6 +172,54 @@ def decode_compact(meta: dict, t_len: int, k: int, nf: int, nc: int,
     if rc != 0:
         raise RuntimeError("native compact decode failed (corrupt grid)")
     return out
+
+
+_META_NAMES = (
+    "lane", "row", "t", "arrival", "action", "side", "is_market",
+    "price", "price_base", "oid_id", "uid_id",
+)
+
+
+def pack_grid(
+    a: dict, rows: np.ndarray, t_off: int, t_grid: int, n_rows: int,
+    val_dtype, market_val: int, add_val: int,
+) -> tuple[dict, dict]:
+    """One grid's scatter + meta extraction in a single native pass (the
+    C++ form of frames.pack_frame_grids' inner loop). `a` is the
+    _frame_arrays dict; `rows` the per-op grid row. Returns (grid dict of
+    [n_rows, t_grid] arrays, meta dict of [m] int64 columns)."""
+    from .book import GRID_I32_FIELDS, DeviceOp
+
+    lib = load()
+    n = a["n"]
+    i64 = lambda x: np.ascontiguousarray(x, np.int64)
+    rows = i64(rows)
+    t = i64(a["t"])
+    m = int(np.count_nonzero((t >= t_off) & (t < t_off + t_grid)))
+    val_dtype = np.dtype(val_dtype)
+    grid = {
+        name: np.zeros(
+            (n_rows, t_grid),
+            np.int32 if name in GRID_I32_FIELDS else val_dtype,
+        )
+        for name in DeviceOp._fields
+    }
+    meta = {name: np.empty(m, np.int64) for name in _META_NAMES}
+    p = lambda arr: arr.ctypes.data_as(_p_i64)
+    v = lambda arr: arr.ctypes.data_as(ctypes.c_void_p)
+    got = lib.go_pack_grid(
+        n, p(rows), p(i64(a["lanes"])), p(t), t_off, t_grid, n_rows,
+        p(i64(a["action"])), p(i64(a["side"])), p(i64(a["kind"])),
+        p(i64(a["price"])), p(i64(a["volume"])), p(i64(a["oid_ids"])),
+        p(i64(a["uid_ids"])), p(i64(a["bases"])), market_val, add_val,
+        v(grid["action"]), v(grid["side"]), v(grid["is_market"]),
+        v(grid["price"]), v(grid["volume"]), v(grid["oid"]), v(grid["uid"]),
+        val_dtype.itemsize,
+        *(p(meta[name]) for name in _META_NAMES),
+    )
+    if got != m:
+        raise RuntimeError(f"native grid pack failed (packed {got} != {m})")
+    return grid, meta
 
 
 def occurrences(lanes: np.ndarray, keep, n_lanes: int) -> np.ndarray:

@@ -220,6 +220,14 @@ class NativePrePool:
         import ctypes
 
         self._h = ctypes.c_void_p(self._lib.gp_new())
+        # String-list -> packed (data, offs) for the C call, keyed by list
+        # identity: the wire decoder returns the same list object for a
+        # repeated dictionary (bus.colwire), so a stable symbol universe
+        # encodes its 10K+ strings once, not once per frame. Decoded
+        # dictionaries are shared/immutable by contract (colwire).
+        from ..utils.cache import IdentityCache
+
+        self._packed_cache = IdentityCache()
 
     def __del__(self):
         h, self._h = self._h, None
@@ -297,14 +305,20 @@ class NativePrePool:
         return out
 
     # -- fused frame passes ------------------------------------------------
+    def _packed(self, strs):
+        ent = self._packed_cache.get(strs)
+        if ent is None:
+            ent = self._packed_cache.put(strs, self._nh.pack_strlist(strs))
+        return ent
+
     def _frame(self, cols: dict, mode: int, sel=None):
         import ctypes
 
         nh = self._nh
         n = int(cols["n"])
         action = np.ascontiguousarray(cols["action"], np.uint8)
-        sym_data, sym_offs = nh.pack_strlist(cols["symbols"])
-        uuid_data, uuid_offs = nh.pack_strlist(cols["uuids"])
+        sym_data, sym_offs = self._packed(cols["symbols"])
+        uuid_data, uuid_offs = self._packed(cols["uuids"])
         sym_idx = np.ascontiguousarray(cols["symbol_idx"], np.uint32)
         uuid_idx = np.ascontiguousarray(cols["uuid_idx"], np.uint32)
         oids = np.ascontiguousarray(cols["oids"])

@@ -91,8 +91,13 @@ inline std::pair<const char*, int64_t> trim_padded(const char* p,
 
 // ---------------------------------------------------------------- Interner
 struct Interner {
-  std::vector<uint64_t> hashes;  // 0 = empty
-  std::vector<int64_t> slot_id;
+  // Interleaved {hash, id} slots: one prefetched cache line serves both
+  // the hash compare and the id deref (split arrays cost two misses).
+  struct Slot {
+    uint64_t h;  // 0 = empty
+    int64_t id;
+  };
+  std::vector<Slot> slots;
   size_t mask = 0, count = 0;
   Arena arena;
   std::vector<StrRef> strs;  // id-1 -> bytes
@@ -101,18 +106,15 @@ struct Interner {
   Interner() { rehash(1 << 12); }
 
   void rehash(size_t new_cap) {
-    std::vector<uint64_t> h2(new_cap, 0);
-    std::vector<int64_t> id2(new_cap, 0);
+    std::vector<Slot> s2(new_cap, Slot{0, 0});
     size_t m2 = new_cap - 1;
-    for (size_t i = 0; i <= mask && !hashes.empty(); ++i) {
-      if (!hashes[i]) continue;
-      size_t j = hashes[i] & m2;
-      while (h2[j]) j = (j + 1) & m2;
-      h2[j] = hashes[i];
-      id2[j] = slot_id[i];
+    for (size_t i = 0; i <= mask && !slots.empty(); ++i) {
+      if (!slots[i].h) continue;
+      size_t j = slots[i].h & m2;
+      while (s2[j].h) j = (j + 1) & m2;
+      s2[j] = slots[i];
     }
-    hashes.swap(h2);
-    slot_id.swap(id2);
+    slots.swap(s2);
     mask = m2;
   }
 
@@ -122,18 +124,17 @@ struct Interner {
 
   int64_t intern_hashed(const char* p, size_t n, uint64_t h) {
     size_t i = h & mask;
-    while (hashes[i]) {
-      if (hashes[i] == h) {
-        const StrRef& s = strs[static_cast<size_t>(slot_id[i] - 1)];
-        if (s.len == n && std::memcmp(s.p, p, n) == 0) return slot_id[i];
+    while (slots[i].h) {
+      if (slots[i].h == h) {
+        const StrRef& s = strs[static_cast<size_t>(slots[i].id - 1)];
+        if (s.len == n && std::memcmp(s.p, p, n) == 0) return slots[i].id;
       }
       i = (i + 1) & mask;
     }
     const char* stored = arena.put(p, n);
     strs.push_back({stored, static_cast<uint32_t>(n)});
     int64_t id = static_cast<int64_t>(strs.size());
-    hashes[i] = h;
-    slot_id[i] = id;
+    slots[i] = {h, id};
     if (static_cast<int64_t>(n) > max_len) max_len = static_cast<int64_t>(n);
     if (++count * 4 > (mask + 1) * 3) rehash((mask + 1) * 2);
     return id;
@@ -142,10 +143,10 @@ struct Interner {
   int64_t get(const char* p, size_t n) const {
     uint64_t h = hash_bytes(p, n);
     size_t i = h & mask;
-    while (hashes[i]) {
-      if (hashes[i] == h) {
-        const StrRef& s = strs[static_cast<size_t>(slot_id[i] - 1)];
-        if (s.len == n && std::memcmp(s.p, p, n) == 0) return slot_id[i];
+    while (slots[i].h) {
+      if (slots[i].h == h) {
+        const StrRef& s = strs[static_cast<size_t>(slots[i].id - 1)];
+        if (s.len == n && std::memcmp(s.p, p, n) == 0) return slots[i].id;
       }
       i = (i + 1) & mask;
     }
@@ -155,9 +156,13 @@ struct Interner {
 
 // ---------------------------------------------------------------- PrePool
 struct PrePool {
-  // refs: 0 = empty, -1 = tombstone, else index+1 into keys.
-  std::vector<uint64_t> hashes;
-  std::vector<int64_t> refs;
+  // Interleaved {hash, ref} slots (one prefetched line serves both).
+  // ref: 0 = empty, -1 = tombstone, else index+1 into keys.
+  struct Slot {
+    uint64_t h;
+    int64_t ref;
+  };
+  std::vector<Slot> slots;
   size_t mask = 0, live = 0, tombs = 0;
   Arena arena;
   std::vector<StrRef> keys;       // append-only; dead entries len = 0
@@ -172,23 +177,20 @@ struct PrePool {
     Arena a2;
     std::vector<StrRef> k2;
     std::vector<uint8_t> l2;
-    std::vector<uint64_t> h2(new_cap, 0);
-    std::vector<int64_t> r2(new_cap, 0);
+    std::vector<Slot> s2(new_cap, Slot{0, 0});
     size_t m2 = new_cap - 1;
     k2.reserve(live);
-    for (size_t i = 0; i <= mask && !hashes.empty(); ++i) {
-      if (!hashes[i] || refs[i] <= 0) continue;
-      const StrRef& s = keys[static_cast<size_t>(refs[i] - 1)];
+    for (size_t i = 0; i <= mask && !slots.empty(); ++i) {
+      if (!slots[i].h || slots[i].ref <= 0) continue;
+      const StrRef& s = keys[static_cast<size_t>(slots[i].ref - 1)];
       const char* stored = a2.put(s.p, s.len);
       k2.push_back({stored, s.len});
       l2.push_back(1);
-      size_t j = hashes[i] & m2;
-      while (h2[j]) j = (j + 1) & m2;
-      h2[j] = hashes[i];
-      r2[j] = static_cast<int64_t>(k2.size());
+      size_t j = slots[i].h & m2;
+      while (s2[j].h) j = (j + 1) & m2;
+      s2[j] = {slots[i].h, static_cast<int64_t>(k2.size())};
     }
-    hashes.swap(h2);
-    refs.swap(r2);
+    slots.swap(s2);
     arena = std::move(a2);
     keys.swap(k2);
     key_live.swap(l2);
@@ -204,9 +206,9 @@ struct PrePool {
   // returns slot index holding the key, or SIZE_MAX.
   size_t find(const char* p, size_t n, uint64_t h) const {
     size_t i = h & mask;
-    while (hashes[i] || refs[i] == -1) {
-      if (hashes[i] == h && refs[i] > 0) {
-        const StrRef& s = keys[static_cast<size_t>(refs[i] - 1)];
+    while (slots[i].h || slots[i].ref == -1) {
+      if (slots[i].h == h && slots[i].ref > 0) {
+        const StrRef& s = keys[static_cast<size_t>(slots[i].ref - 1)];
         if (s.len == n && std::memcmp(s.p, p, n) == 0) return i;
       }
       i = (i + 1) & mask;
@@ -221,13 +223,12 @@ struct PrePool {
   bool insert_hashed(const char* p, size_t n, uint64_t h) {
     if (find(p, n, h) != SIZE_MAX) return false;
     size_t i = h & mask;
-    while (hashes[i] && refs[i] != -1) i = (i + 1) & mask;
-    if (refs[i] == -1) --tombs;
+    while (slots[i].h && slots[i].ref != -1) i = (i + 1) & mask;
+    if (slots[i].ref == -1) --tombs;
     const char* stored = arena.put(p, n);
     keys.push_back({stored, static_cast<uint32_t>(n)});
     key_live.push_back(1);
-    hashes[i] = h;
-    refs[i] = static_cast<int64_t>(keys.size());
+    slots[i] = {h, static_cast<int64_t>(keys.size())};
     ++live;
     maybe_grow();
     return true;
@@ -240,9 +241,8 @@ struct PrePool {
   bool erase_hashed(const char* p, size_t n, uint64_t h) {
     size_t i = find(p, n, h);
     if (i == SIZE_MAX) return false;
-    key_live[static_cast<size_t>(refs[i] - 1)] = 0;
-    hashes[i] = 0;
-    refs[i] = -1;  // tombstone keeps probe chains intact
+    key_live[static_cast<size_t>(slots[i].ref - 1)] = 0;
+    slots[i] = {0, -1};  // tombstone keeps probe chains intact
     --live;
     ++tombs;
     if (tombs * 2 > mask + 1) rehash(mask + 1);
@@ -302,8 +302,7 @@ void gi_intern_batch(void* h, const char* data, int64_t n, int64_t width,
     for (int64_t j = 0; j < m; ++j) {
       auto [p, len] = trim_padded(data + (base + j) * width, width);
       hs[j] = hash_bytes(p, static_cast<size_t>(len));
-      __builtin_prefetch(&in.hashes[hs[j] & in.mask]);
-      __builtin_prefetch(&in.slot_id[hs[j] & in.mask]);
+      __builtin_prefetch(&in.slots[hs[j] & in.mask]);
     }
     for (int64_t j = 0; j < m; ++j) {
       auto [p, len] = trim_padded(data + (base + j) * width, width);
@@ -422,8 +421,7 @@ int64_t gp_contains(void* h, const char* p, int64_t len) {
 void gp_clear(void* h) {
   auto& pp = *static_cast<PrePool*>(h);
   std::lock_guard<std::mutex> g(pp.mu);
-  pp.hashes.assign(pp.mask + 1, 0);
-  pp.refs.assign(pp.mask + 1, 0);
+  pp.slots.assign(pp.mask + 1, PrePool::Slot{0, 0});
   pp.arena = Arena();
   pp.keys.clear();
   pp.key_live.clear();
@@ -511,8 +509,27 @@ int64_t gp_frame(void* h, int64_t n, const uint8_t* action,
     }
     for (int64_t j = 0; j < m; ++j) {
       hs[j] = hash_bytes(scratch.data() + offs[j], offs[j + 1] - offs[j]);
-      __builtin_prefetch(&pp.hashes[hs[j] & pp.mask]);
-      __builtin_prefetch(&pp.refs[hs[j] & pp.mask]);
+      __builtin_prefetch(&pp.slots[hs[j] & pp.mask]);
+    }
+    // Staged speculative prefetch along the expected hit path: the slot
+    // line is in flight from the loop above; touch it to prefetch the
+    // StrRef entry it references, then the key bytes that entry points
+    // at. Each stage runs across the whole block, so the three dependent
+    // misses of a probe overlap block-wide instead of serializing
+    // per key. Pure hints — stage 3's erase/insert re-probes for real
+    // (tombstoning or a rehash mid-block only wastes a prefetch).
+    const StrRef* krefs[B];
+    for (int64_t j = 0; j < m; ++j) {
+      const PrePool::Slot& s = pp.slots[hs[j] & pp.mask];
+      if (s.h == hs[j] && s.ref > 0) {
+        krefs[j] = &pp.keys[static_cast<size_t>(s.ref - 1)];
+        __builtin_prefetch(krefs[j]);
+      } else {
+        krefs[j] = nullptr;
+      }
+    }
+    for (int64_t j = 0; j < m; ++j) {
+      if (krefs[j]) __builtin_prefetch(krefs[j]->p);
     }
     for (int64_t j = 0; j < m; ++j) {
       const char* kp = scratch.data() + offs[j];
@@ -567,25 +584,41 @@ int64_t go_decode_compact(
     op_index[static_cast<size_t>(op_row[i] * t_len + op_t[i])] =
         static_cast<int32_t>(i);
 
+  // The op meta arrives as 10 parallel column arrays; per-event access by
+  // `pos` is random, so gather the 7 fields an event needs into one
+  // 64-byte struct first (sequential pass) — each event then touches ONE
+  // meta cache line instead of seven.
+  struct OpMeta {
+    int64_t arrival, lane, uid, oid, side, price, base;
+    int64_t mkt;
+  };
+  std::vector<OpMeta> om(static_cast<size_t>(m));
+  for (int64_t i = 0; i < m; ++i)
+    om[static_cast<size_t>(i)] = {op_arrival[i], op_lane[i],  op_uid[i],
+                                  op_oid[i],     op_side[i],  op_price[i],
+                                  op_base[i],    op_is_market[i]};
+
   int64_t ne = nf + nc;
   std::vector<int64_t> ev_pos(static_cast<size_t>(ne));   // op index
   std::vector<int64_t> ev_arr(static_cast<size_t>(ne));   // arrival
   std::vector<int64_t> counts(static_cast<size_t>(frame_n) + 1, 0);
+  constexpr int64_t PF = 12;  // software prefetch distance
   for (int64_t e = 0; e < nf; ++e) {
     int64_t src = f_src[e];
     int64_t pos = op_index[static_cast<size_t>(src / k)];
     if (pos < 0) return -1;  // fill without a packed ADD: corrupt
     ev_pos[static_cast<size_t>(e)] = pos;
-    int64_t a = op_arrival[pos];
-    ev_arr[static_cast<size_t>(e)] = a;
-    ++counts[static_cast<size_t>(a)];
   }
   for (int64_t e = 0; e < nc; ++e) {
     int64_t pos = op_index[static_cast<size_t>(c_src[e])];
     if (pos < 0) return -1;
     ev_pos[static_cast<size_t>(nf + e)] = pos;
-    int64_t a = op_arrival[pos];
-    ev_arr[static_cast<size_t>(nf + e)] = a;
+  }
+  for (int64_t e = 0; e < ne; ++e) {
+    if (e + PF < ne)
+      __builtin_prefetch(&om[static_cast<size_t>(ev_pos[e + PF])]);
+    int64_t a = om[static_cast<size_t>(ev_pos[e])].arrival;
+    ev_arr[static_cast<size_t>(e)] = a;
     ++counts[static_cast<size_t>(a)];
   }
   int64_t run = 0;
@@ -594,24 +627,44 @@ int64_t go_decode_compact(
     counts[a] = run;
     run += c;
   }
-  for (int64_t e = 0; e < ne; ++e) {
+  // Counting-sort permutation, then emit in DESTINATION order: the 14
+  // output columns become pure sequential streams (the random side —
+  // event + meta structs — is prefetched ahead), instead of 14 random
+  // cache-line RFOs per event.
+  std::vector<int64_t> src_of(static_cast<size_t>(ne));
+  for (int64_t e = 0; e < ne; ++e)
+    src_of[static_cast<size_t>(
+        counts[static_cast<size_t>(ev_arr[static_cast<size_t>(e)])]++)] = e;
+  for (int64_t dst = 0; dst < ne; ++dst) {
+    if (dst + PF < ne) {
+      int64_t en = src_of[static_cast<size_t>(dst + PF)];
+      __builtin_prefetch(&ev_pos[en]);
+      if (en < nf) {
+        __builtin_prefetch(&f_price[en]);
+        __builtin_prefetch(&f_qty[en]);
+      }
+    }
+    if (dst + PF / 2 < ne) {
+      int64_t en = src_of[static_cast<size_t>(dst + PF / 2)];
+      __builtin_prefetch(&om[static_cast<size_t>(ev_pos[en])]);
+    }
+    int64_t e = src_of[static_cast<size_t>(dst)];
     bool cancel = e >= nf;
-    int64_t pos = ev_pos[static_cast<size_t>(e)];
-    int64_t dst = counts[static_cast<size_t>(ev_arr[static_cast<size_t>(e)])]++;
-    arrival[dst] = ev_arr[static_cast<size_t>(e)];
+    const OpMeta& o = om[static_cast<size_t>(ev_pos[e])];
+    arrival[dst] = o.arrival;
     is_cancel[dst] = cancel ? 1 : 0;
-    symbol_id[dst] = op_lane[pos];
-    taker_uid[dst] = op_uid[pos];
-    taker_oid[dst] = op_oid[pos];
-    taker_side[dst] = static_cast<int8_t>(op_side[pos]);
-    taker_price[dst] = op_price[pos];
+    symbol_id[dst] = o.lane;
+    taker_uid[dst] = o.uid;
+    taker_oid[dst] = o.oid;
+    taker_side[dst] = static_cast<int8_t>(o.side);
+    taker_price[dst] = o.price;
     if (cancel) {
       int64_t e2 = e - nf;
       int64_t vol = c_vol[e2];
       taker_volume[dst] = vol;
-      maker_uid[dst] = op_uid[pos];
-      maker_oid[dst] = op_oid[pos];
-      fill_price[dst] = op_price[pos];
+      maker_uid[dst] = o.uid;
+      maker_oid[dst] = o.oid;
+      fill_price[dst] = o.price;
       maker_volume[dst] = vol;
       match_volume[dst] = 0;
       is_market[dst] = 0;
@@ -619,13 +672,75 @@ int64_t go_decode_compact(
       taker_volume[dst] = f_after[e];
       maker_uid[dst] = f_muid[e];
       maker_oid[dst] = f_moid[e];
-      fill_price[dst] = f_price[e] + op_base[pos];
+      fill_price[dst] = f_price[e] + o.base;
       maker_volume[dst] = f_mvol[e];
       match_volume[dst] = f_qty[e];
-      is_market[dst] = op_is_market[pos] ? 1 : 0;
+      is_market[dst] = o.mkt ? 1 : 0;
     }
   }
   return 0;
+}
+
+// Fused grid pack: one linear pass selects the frame ops landing in this
+// grid's time window, scatters all 7 op fields into the (pre-zeroed) grid
+// arrays, and extracts the packed-op meta columns the event decoder needs
+// — replacing ~20 separate numpy mask/scatter passes in
+// frames.pack_frame_grids. Value grids are int32 or int64 (val_itemsize).
+// Meta outputs are int64 [m] where m = |{i : t_off <= t[i] < t_off+t_grid}|
+// (the caller sizes them with one count pass). Returns the number packed
+// (must equal m) or -1 on a row/t out of grid bounds (corrupt input).
+int64_t go_pack_grid(
+    int64_t n, const int64_t* rows, const int64_t* lanes, const int64_t* t,
+    int64_t t_off, int64_t t_grid, int64_t n_rows,
+    const int64_t* action, const int64_t* side, const int64_t* kind,
+    const int64_t* price, const int64_t* volume, const int64_t* oid_ids,
+    const int64_t* uid_ids, const int64_t* bases, int64_t market_val,
+    int64_t add_val,
+    int32_t* g_action, int32_t* g_side, int32_t* g_market, void* g_price,
+    void* g_volume, void* g_oid, void* g_uid, int64_t val_itemsize,
+    int64_t* m_lane, int64_t* m_row, int64_t* m_t, int64_t* m_arrival,
+    int64_t* m_action, int64_t* m_side, int64_t* m_market, int64_t* m_price,
+    int64_t* m_base, int64_t* m_oid, int64_t* m_uid) {
+  bool wide = val_itemsize == 8;
+  int64_t j = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t ti = t[i];
+    if (ti < t_off || ti >= t_off + t_grid) continue;
+    int64_t tt = ti - t_off;
+    int64_t r = rows[i];
+    if (r < 0 || r >= n_rows) return -1;
+    int64_t flat = r * t_grid + tt;
+    int64_t a = action[i];
+    bool is_mkt = kind[i] == market_val && a == add_val;
+    int64_t p_dev = is_mkt ? 0 : price[i] - bases[i];
+    g_action[flat] = static_cast<int32_t>(a);
+    g_side[flat] = static_cast<int32_t>(side[i]);
+    g_market[flat] = is_mkt ? 1 : 0;
+    if (wide) {
+      static_cast<int64_t*>(g_price)[flat] = p_dev;
+      static_cast<int64_t*>(g_volume)[flat] = volume[i];
+      static_cast<int64_t*>(g_oid)[flat] = oid_ids[i];
+      static_cast<int64_t*>(g_uid)[flat] = uid_ids[i];
+    } else {
+      static_cast<int32_t*>(g_price)[flat] = static_cast<int32_t>(p_dev);
+      static_cast<int32_t*>(g_volume)[flat] = static_cast<int32_t>(volume[i]);
+      static_cast<int32_t*>(g_oid)[flat] = static_cast<int32_t>(oid_ids[i]);
+      static_cast<int32_t*>(g_uid)[flat] = static_cast<int32_t>(uid_ids[i]);
+    }
+    m_lane[j] = lanes[i];
+    m_row[j] = r;
+    m_t[j] = tt;
+    m_arrival[j] = i;
+    m_action[j] = a;
+    m_side[j] = side[i];
+    m_market[j] = is_mkt ? 1 : 0;
+    m_price[j] = price[i];
+    m_base[j] = bases[i];
+    m_oid[j] = oid_ids[i];
+    m_uid[j] = uid_ids[i];
+    ++j;
+  }
+  return j;
 }
 
 // Per-lane occurrence index in arrival order: out_t[i] = number of earlier

@@ -16,7 +16,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 import bench
-from bench import _enable_jax_cache, _svc_columns, _svc_gateway_step
+from bench import (
+    _enable_jax_cache,
+    _svc_columns,
+    _svc_gateway_step,
+    _svc_warmup,
+)
 
 _enable_jax_cache()
 if os.environ.get("PROF_PLATFORM"):
@@ -51,20 +56,11 @@ consumer = OrderConsumer(
 rng = np.random.default_rng(7)
 symbols = [f"sym{i}" for i in range(S)]
 FRAME = min(FRAME, N)
-oid0 = 1
-# Same warm-until-stable loop as bench.py service_main: profile only
-# steady-state frames (a frame that grows a geometry ratchet re-traces,
-# which the bench also keeps off the clock).
-n_warm = 0
-stable = 0
-while n_warm < 8 and (n_warm < 2 or stable < 2):
-    cols = _svc_columns(rng, FRAME, S, oid0)
-    oid0 += FRAME
-    geo = engine.batch.geometry_floors()
-    _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
-    consumer.drain()
-    stable = stable + 1 if engine.batch.geometry_floors() == geo else 0
-    n_warm += 1
+# Same warm-until-stable + margin-pinning as bench.py service_main:
+# profile only steady-state frames.
+n_warm, oid0 = _svc_warmup(
+    engine, consumer, bus, rng, FRAME, S, symbols, oid0=1
+)
 print(f"warm_frames={n_warm}", file=sys.stderr)
 
 frames_cols = []

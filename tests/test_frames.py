@@ -308,3 +308,51 @@ def test_fast_path_cap_below_max_fills():
     got = run_frames(eng, orders, 7, fast=True)
     assert got == _oracle(orders)
     eng.verify_books()
+
+
+def test_lane_growth_survives_rollback_retry():
+    """A frame that (a) auto-grows the lane axis and (b) trips the fast
+    path's fills-buffer budget must still succeed via the exact fallback:
+    the rollback shrinks n_slots back, and the retry's lane map must
+    re-grow rather than reuse cached lane ids past the restored stack
+    (regression: the identity-cached lane map skipped _lane()'s growth
+    side effect after _restore)."""
+    from gome_tpu.engine.frames import apply_frame_fast
+
+    eng = BatchEngine(
+        BookConfig(cap=256, max_fills=256), n_slots=2, max_t=512
+    )
+    # Rest 200 one-lot asks on s0 (fills floor stays minimal: no fills).
+    rest = [
+        Order(
+            uuid="u", oid=f"a{i}", symbol="s0", side=Side.SALE,
+            price=1000, volume=1, action=Action.ADD,
+            order_type=OrderType.LIMIT,
+        )
+        for i in range(200)
+    ]
+    cols = colwire.decode_order_frame(orders_to_frame(rest))
+    apply_frame_fast(eng, cols)
+    # One frame: a 200-lot sweep on s0 (200 fills >> the 64-slot fills
+    # buffer for n_ops=4 -> _NeedExact -> rollback -> exact retry) PLUS
+    # three new symbols that force lane growth 2 -> 8 in the same frame.
+    sweep = [
+        Order(
+            uuid="u", oid="big", symbol="s0", side=Side.BUY,
+            price=1000, volume=200, action=Action.ADD,
+            order_type=OrderType.LIMIT,
+        )
+    ] + [
+        Order(
+            uuid="u", oid=f"n{i}", symbol=f"new{i}", side=Side.BUY,
+            price=1000, volume=1, action=Action.ADD,
+            order_type=OrderType.LIMIT,
+        )
+        for i in range(3)
+    ]
+    cols2 = colwire.decode_order_frame(orders_to_frame(sweep))
+    batch = apply_frame_fast(eng, cols2)
+    fills = [e for e in batch.to_results() if not e.is_cancel]
+    assert len(fills) == 200
+    assert eng.n_slots >= 4  # growth stuck after the retry
+    assert eng.stats.fills == 200
