@@ -272,7 +272,9 @@ def _svc_columns(rng, n, n_symbols, oid0):
     """Raw order columns — what the gRPC handlers would have accumulated.
     Data GENERATION is the load client's job and stays off the clock; all
     gateway work on these columns (frame encode, pre-pool marking,
-    publish) is timed."""
+    publish) is timed. This is the CLEAN stream: 100% limit ADDs, uniform
+    symbols, one uuid — the upper-bound measurement. The headline uses
+    _svc_columns_mixed (the reference-driver-shaped flow)."""
     return dict(
         n=n,
         action=np.ones(n, np.uint8),
@@ -288,28 +290,148 @@ def _svc_columns(rng, n, n_symbols, oid0):
     )
 
 
-def _svc_gateway_step(cols, symbols, pool, queue):
+class _MixedFlow:
+    """Config-5-shaped service load (the reference driver randomizes both
+    sides and the new framework's config 5 adds markets + depth walks,
+    doorder.go:38-47): ~15% cancels (a fifth of them targeting ADDs from
+    the SAME frame, some ordered before their ADD — the
+    cancel-before-consume race the pre-pool exists for, SURVEY §2.3.3),
+    ~25% market orders among ADDs, 256 distinct uuids, Zipf(1) symbol
+    popularity. Stateful: cancels target really-issued (symbol, oid,
+    price) triples from a rolling pool of resting limit orders."""
+
+    CANCEL_P = 0.15
+    MARKET_P = 0.25
+    SAME_FRAME_P = 0.2  # fraction of cancels aimed at this frame's ADDs
+    N_UUIDS = 256
+    POOL_MAX = 1 << 20
+
+    def __init__(self, rng, n_symbols):
+        self.rng = rng
+        ranks = np.arange(1, n_symbols + 1, dtype=np.float64)
+        w = 1.0 / ranks
+        self.sym_p = w / w.sum()
+        self.n_symbols = n_symbols
+        self.oid0 = 1
+        # Rolling pool of cancellable resting orders (ring buffer).
+        self.pool_sym = np.zeros(self.POOL_MAX, np.uint32)
+        self.pool_price = np.zeros(self.POOL_MAX, np.int64)
+        self.pool_oid = np.zeros(self.POOL_MAX, np.int64)
+        self.pool_uuid = np.zeros(self.POOL_MAX, np.uint32)
+        self.pool_n = 0
+        self.pool_head = 0
+
+    def _pool_push(self, sym, price, oid, uuid):
+        k = len(sym)
+        idx = (self.pool_head + np.arange(k)) % self.POOL_MAX
+        self.pool_sym[idx] = sym
+        self.pool_price[idx] = price
+        self.pool_oid[idx] = oid
+        self.pool_uuid[idx] = uuid
+        self.pool_head = (self.pool_head + k) % self.POOL_MAX
+        self.pool_n = min(self.pool_n + k, self.POOL_MAX)
+
+    def frame(self, n):
+        rng = self.rng
+        action = np.ones(n, np.uint8)
+        dels = rng.random(n) < self.CANCEL_P
+        if self.pool_n == 0:
+            dels[:] = False
+        action[dels] = 2
+        adds = ~dels
+        n_add = int(adds.sum())
+
+        sym = rng.choice(
+            self.n_symbols, size=n, p=self.sym_p
+        ).astype(np.uint32)
+        price = rng.integers(99_500_000, 100_500_000, n).astype(np.int64)
+        volume = rng.integers(1, 101, n).astype(np.int64)
+        kind = np.zeros(n, np.uint8)
+        mkt = adds & (rng.random(n) < self.MARKET_P)
+        kind[mkt] = 1
+        oid_nums = np.zeros(n, np.int64)
+        oid_nums[adds] = self.oid0 + np.arange(n_add)
+        self.oid0 += n_add
+        uuid_idx = rng.integers(0, self.N_UUIDS, n).astype(np.uint32)
+
+        # Cancels carry the original order's (symbol, uuid, oid, price) —
+        # the pre-pool key is S:U:O (ordernode.go:89-92) and the book
+        # lookup needs the exact resting price (engine.go:92-93). Mostly
+        # resting orders from earlier frames; some from THIS frame's
+        # limit ADDs (the cancel-before-consume race when the DEL
+        # precedes its ADD in the stream).
+        di = np.nonzero(dels)[0]
+        if len(di):
+            same = rng.random(len(di)) < self.SAME_FRAME_P
+            ai = np.nonzero(adds & (kind == 0))[0]
+            if len(ai) == 0:
+                same[:] = False
+            n_pool = int((~same).sum())
+            if n_pool:
+                pi = rng.integers(0, self.pool_n, n_pool)
+                tgt = di[~same]
+                sym[tgt] = self.pool_sym[pi]
+                price[tgt] = self.pool_price[pi]
+                oid_nums[tgt] = self.pool_oid[pi]
+                uuid_idx[tgt] = self.pool_uuid[pi]
+            if same.any():
+                ti = rng.integers(0, len(ai), int(same.sum()))
+                src = ai[ti]
+                tgt = di[same]
+                sym[tgt] = sym[src]
+                price[tgt] = price[src]
+                oid_nums[tgt] = oid_nums[src]
+                uuid_idx[tgt] = uuid_idx[src]
+
+        rest = adds & (kind == 0)
+        self._pool_push(
+            sym[rest], price[rest], oid_nums[rest], uuid_idx[rest]
+        )
+        return dict(
+            n=n,
+            action=action,
+            side=rng.integers(0, 2, n).astype(np.uint8),
+            kind=kind,
+            price=np.where(mkt, 0, price),
+            volume=volume,
+            symbol_idx=sym,
+            uuid_idx=uuid_idx,
+            oids=np.char.add(
+                "o", oid_nums.astype("U12")
+            ).astype("S"),
+        )
+
+
+_SVC_UUIDS = [f"u{i}" for i in range(256)]  # shared uuid dictionary
+
+
+def _svc_gateway_step(cols, symbols, pool, queue, uuids=_SVC_UUIDS):
     """The gateway's per-frame work, all ON the clock: wire-encode the
     frame (the batching DoOrder handler's output), mark the pre-pool
     (main.go:44-45 for every ADD), publish to doOrder."""
     from gome_tpu.bus.colwire import encode_order_frame
 
-    cols = dict(cols, symbols=symbols, uuids=["u"])
+    cols = dict(cols, symbols=symbols, uuids=uuids)
     payload = encode_order_frame(
         cols["n"], cols["action"], cols["side"], cols["kind"],
         cols["price"], cols["volume"], symbols, cols["symbol_idx"],
-        ["u"], cols["uuid_idx"], cols["oids"],
+        uuids, cols["uuid_idx"], cols["oids"],
     )
     mark_frame = getattr(pool, "mark_frame", None)
     if mark_frame is not None:
         mark_frame(cols)
     else:
-        for k, o in zip(cols["symbol_idx"].tolist(), cols["oids"].tolist()):
-            pool.add((symbols[k], "u", o.decode()))
+        ADD = 1
+        for a, k, u, o in zip(
+            cols["action"].tolist(), cols["symbol_idx"].tolist(),
+            cols["uuid_idx"].tolist(), cols["oids"].tolist(),
+        ):
+            if a == ADD:
+                pool.add((symbols[k], uuids[u], o.decode()))
     queue.publish(payload)
 
 
-def _svc_warmup(engine, consumer, bus, rng, frame, s, symbols, oid0):
+def _svc_warmup(engine, consumer, bus, make_frame, symbols):
     """Warm the service pipeline until its compiled shapes are pinned.
 
     Frame geometry (grid-2 packed rows/depth ratchets, compaction buffer
@@ -327,12 +449,12 @@ def _svc_warmup(engine, consumer, bus, rng, frame, s, symbols, oid0):
          per-frame fluctuation — and run one more frame so the margined
          shapes compile too.
 
-    Returns (warm frames consumed, next oid)."""
+    make_frame() produces one frame's columns (a stateful generator —
+    clean or mixed flow). Returns the number of warm frames consumed."""
     n_warm = 0
     stable = 0
     while n_warm < 8 and (n_warm < 2 or stable < 2):
-        cols = _svc_columns(rng, frame, s, oid0)
-        oid0 += frame
+        cols = make_frame()
         geo = engine.batch.geometry_floors()
         _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
         consumer.drain()
@@ -342,15 +464,13 @@ def _svc_warmup(engine, consumer, bus, rng, frame, s, symbols, oid0):
     engine.batch.prewarm_geometry(
         rows_floor=2 * g["rows_floor"],
         t_floor=2 * g["t_floor"],
-        cancels_buf=2 * g["cancels_buf"],
-        # fills_buf is dominated by pow2(frame n_ops), which is fixed by
-        # the frame size — no margin needed.
+        cancels_buf={b: 2 * v for b, v in g["cancels_buf"].items()},
+        # fills_buf is dominated by pow2(grid n_ops) within each class —
+        # no margin needed.
     )
-    cols = _svc_columns(rng, frame, s, oid0)
-    oid0 += frame
-    _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
+    _svc_gateway_step(make_frame(), symbols, engine.pre_pool, bus.order_queue)
     consumer.drain()
-    return n_warm + 1, oid0
+    return n_warm + 1
 
 
 def service_main():
@@ -403,72 +523,365 @@ def service_main():
 
     rng = np.random.default_rng(7)
     symbols = [f"sym{i}" for i in range(S)]
-
     FRAME = min(FRAME, N)
-    n_warm, oid0 = _svc_warmup(
-        engine, consumer, bus, rng, FRAME, S, symbols, oid0=1
-    )
-
-    frames_cols = []
-    for start in range(0, N, FRAME):
-        n = min(FRAME, N - start)
-        frames_cols.append(_svc_columns(rng, n, S, oid0))
-        oid0 += n
-    engine_frames.FETCH_SECONDS = 0.0
-    ev_skip = bus.match_queue.end_offset()  # warmup frames' events
-
-    # Gateway phase (timed): encode + mark + publish every frame.
-    t0 = time.perf_counter()
-    for cols in frames_cols:
-        _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
-    t_gateway = time.perf_counter() - t0
-
-    # Consumer phase (timed): drain to matchOrder. process_time tracks
-    # the CPU this process actually spent (excludes time blocked on the
-    # tunnel AND CPU stolen by the tunnel proxy — the stable cost measure
-    # on a contended 1-core dev host).
-    t0 = time.perf_counter()
-    c0 = time.process_time()
-    n_done = consumer.drain()
-    t_consumer = time.perf_counter() - t0
-    cpu_consumer = time.process_time() - c0
-    fetch_s = engine_frames.FETCH_SECONDS
-    elapsed = t_gateway + t_consumer
 
     from gome_tpu.bus.colwire import decode_event_frame
 
-    n_events = 0
-    ev_bytes = 0
-    for m in bus.match_queue.read_from(ev_skip, 1 << 30):
-        ev_bytes += len(m.body)
-        n_events += len(decode_event_frame(m.body))
+    def run_stream(label, make_frame):
+        """Warm (off clock) then time one stream: gateway phase + consumer
+        drain. Returns the measurement dict and prints the stderr
+        breakdown. process_time tracks the CPU this process actually
+        spent (excludes time blocked on the tunnel AND CPU stolen by the
+        tunnel proxy — the stable cost measure on a contended 1-core dev
+        host)."""
+        n_warm = _svc_warmup(engine, consumer, bus, make_frame, symbols)
+        frames_cols = [make_frame() for _ in range(-(-N // FRAME))]
+        n_total = sum(int(c["n"]) for c in frames_cols)
+        engine_frames.FETCH_SECONDS = 0.0
+        ev_skip = bus.match_queue.end_offset()  # warmup frames' events
+        st0 = (engine.stats.device_calls, engine.stats.cap_escalations)
 
-    throughput = n_done / elapsed
+        # Gateway phase (timed): encode + mark + publish every frame.
+        t0 = time.perf_counter()
+        for cols in frames_cols:
+            _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
+        t_gateway = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        c0 = time.process_time()
+        n_done = consumer.drain()
+        t_consumer = time.perf_counter() - t0
+        cpu_consumer = time.process_time() - c0
+        fetch_s = engine_frames.FETCH_SECONDS
+        elapsed = t_gateway + t_consumer
+        assert n_done == n_total, (n_done, n_total)
+
+        n_events = 0
+        ev_bytes = 0
+        for m in bus.match_queue.read_from(ev_skip, 1 << 30):
+            ev_bytes += len(m.body)
+            n_events += len(decode_event_frame(m.body))
+        host_s = max(elapsed - fetch_s, 1e-9)
+        meas = dict(
+            label=label,
+            orders=n_done,
+            events=n_events,
+            throughput=n_done / elapsed,
+            ex_fetch=n_done / host_s,
+            consumer_cpu_orders_per_sec_per_core=(
+                n_done / max(cpu_consumer, 1e-9)
+            ),
+            gateway_s=t_gateway,
+            consumer_s=t_consumer,
+            consumer_cpu_s=cpu_consumer,
+            fetch_blocked_s=fetch_s,
+        )
+        print(
+            f"# [{label}] orders={n_done} events={n_events} "
+            f"warm_frames={n_warm} gateway={t_gateway:.3f}s "
+            f"consumer={t_consumer:.3f}s fetch_blocked={fetch_s:.3f}s "
+            f"(dev-tunnel link) | ex-fetch {n_done / host_s / 1e6:.2f}M "
+            f"orders/sec | "
+            f"consumer-only ex-fetch "
+            f"{n_done / max(t_consumer - fetch_s, 1e-9) / 1e6:.2f}M | "
+            f"event-frame bytes/order={ev_bytes / max(n_done, 1):.1f} | "
+            f"device_calls={engine.stats.device_calls - st0[0]} "
+            f"escalations={engine.stats.cap_escalations - st0[1]} | "
+            f"consumer_cpu={cpu_consumer:.3f}s -> "
+            f"{n_done / max(cpu_consumer, 1e-9) / 1e6:.2f}M orders/sec/core",
+            file=sys.stderr,
+        )
+        return meas
+
+    # Clean stream first (pure limit ADDs, uniform symbols — the upper
+    # bound), then the HEADLINE mixed stream (reference-driver shape:
+    # Zipf symbols, ~15% cancels incl. same-frame races, ~25% markets,
+    # 256 uuids). Clean-first also means the mixed phase's extra compiled
+    # shapes (deep dense grids for hot Zipf lanes, cancel buffers) are
+    # charged to the mixed warmup, not the clean timed region.
+    oid_box = [1]
+
+    def clean_frame():
+        cols = _svc_columns(rng, FRAME, S, oid_box[0])
+        oid_box[0] += FRAME
+        return cols
+
+    clean = run_stream("clean", clean_frame)
+    mixed_flow = _MixedFlow(np.random.default_rng(11), S)
+    mixed = run_stream("mixed", lambda: mixed_flow.frame(FRAME))
+
+    throughput = mixed["throughput"]
     result = {
         "metric": (
-            "service throughput gateway->matchOrder (everything after "
-            f"gRPC arrival: frame encode + pre-pool mark + publish + "
-            f"consume/match + event publish + commit), {S} symbols, "
-            f"{FRAME}-order frames, int32 pallas, pipeline depth {PIPE}"
+            "service throughput gateway->matchOrder, MIXED stream "
+            f"(Zipf symbols, ~15% cancels incl. same-frame races, ~25% "
+            f"market orders, 256 uuids; everything after gRPC arrival), "
+            f"{S} symbols, {FRAME}-order frames, int32 pallas, pipeline "
+            f"depth {PIPE}"
         ),
         "value": round(throughput),
         "unit": "orders/sec",
         "vs_baseline": round(throughput / 1_000_000, 3),
     }
     print(json.dumps(result))
-    host_s = max(elapsed - fetch_s, 1e-9)
-    st = engine.stats
     print(
-        f"# orders={n_done} events={n_events} warm_frames={n_warm} "
-        f"gateway={t_gateway:.3f}s "
-        f"consumer={t_consumer:.3f}s fetch_blocked={fetch_s:.3f}s "
-        f"(dev-tunnel link) | ex-fetch {n_done / host_s / 1e6:.2f}M "
-        f"orders/sec | consumer-only {n_done / max(t_consumer, 1e-9) / 1e6:.2f}M "
-        f"(ex-fetch {n_done / max(t_consumer - fetch_s, 1e-9) / 1e6:.2f}M) | "
-        f"event-frame bytes/order={ev_bytes / max(n_done, 1):.1f} | "
-        f"device_calls={st.device_calls} escalations={st.cap_escalations} | "
-        f"consumer_cpu={cpu_consumer:.3f}s -> "
-        f"{n_done / max(cpu_consumer, 1e-9) / 1e6:.2f}M orders/sec/core",
+        f"# mixed vs clean: on-link {mixed['throughput'] / 1e3:.0f}K vs "
+        f"{clean['throughput'] / 1e3:.0f}K orders/sec | consumer CPU "
+        f"{mixed['consumer_cpu_orders_per_sec_per_core'] / 1e6:.2f}M vs "
+        f"{clean['consumer_cpu_orders_per_sec_per_core'] / 1e6:.2f}M "
+        f"orders/sec/core",
+        file=sys.stderr,
+    )
+
+
+def latency_main():
+    """--latency: order->publish latency vs frame size, pipeline depth
+    held constant (the throughput/latency trade-off curve; the reference
+    is fully async and publishes no latency numbers — main.go:49 — so
+    this sets the bar).
+
+    Method: a closed-loop steady-state run per frame size — the gateway
+    publishes a frame, then the consumer takes one step (with cross-frame
+    pipelining, up to `depth` frames stay in flight), so frames complete
+    while later ones are being produced, exactly like production.
+    Completion times attribute FIFO (frames resolve in order). An order's
+    latency = its frame's publish-completion time minus its synthetic
+    arrival time: arrivals are spread uniformly over the frame's
+    accumulation window at the run's own sustained rate (an order that
+    arrives just after a frame closes waits a full accumulation window —
+    the batching bridge's cost, which this measurement deliberately
+    includes; SURVEY L4: who batches and at what latency cost).
+
+    Prints one JSON line per frame size with throughput and
+    p50/p99/p99.9 order->publish latency."""
+    check = "--check" in sys.argv
+    import jax
+
+    _enable_jax_cache()
+    if check:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from gome_tpu.bus import MemoryQueue, QueueBus
+    from gome_tpu.engine import BookConfig
+    from gome_tpu.engine.orchestrator import MatchEngine
+    from gome_tpu.service.consumer import OrderConsumer
+
+    N = int(os.environ.get("SVC_ORDERS", 8_192 if check else 1_048_576))
+    S = int(os.environ.get("SVC_SYMBOLS", 64 if check else 10_240))
+    CAP = int(os.environ.get("SVC_CAP", 32 if check else 256))
+    PIPE = int(os.environ.get("SVC_PIPELINE", 2))
+    sizes = (
+        (512, 2048)
+        if check
+        else tuple(
+            int(x)
+            for x in os.environ.get(
+                "SVC_LATENCY_FRAMES", "4096,32768,262144"
+            ).split(",")
+        )
+    )
+    symbols = [f"sym{i}" for i in range(S)]
+
+    for frame_n in sizes:
+        engine = MatchEngine(
+            config=BookConfig(cap=CAP, max_fills=16, dtype=jnp.int32),
+            n_slots=S,
+            max_t=32,
+            kernel="pallas",
+        )
+        bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+        consumer = OrderConsumer(
+            engine, bus, batch_n=1, batch_wait_s=0, match_wire="frame",
+            pipeline_depth=PIPE,
+        )
+        flow = _MixedFlow(np.random.default_rng(11), S)
+        make_frame = lambda: flow.frame(frame_n)
+        _svc_warmup(engine, consumer, bus, make_frame, symbols)
+
+        n_frames = max(PIPE + 2, N // frame_n)
+        frames = [make_frame() for _ in range(n_frames)]
+        pub_t: list = []  # publish time per frame, FIFO
+        done_t: list = []
+        t0 = time.perf_counter()
+        for cols in frames:
+            pub_t.append(time.perf_counter())
+            _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
+            n = consumer.run_once()
+            now = time.perf_counter()
+            for _ in range(n // frame_n):
+                done_t.append(now)
+        while len(done_t) < n_frames:
+            n = consumer.run_once()
+            now = time.perf_counter()
+            for _ in range(n // frame_n):
+                done_t.append(now)
+        elapsed = time.perf_counter() - t0
+        total = n_frames * frame_n
+        rate = total / elapsed
+
+        # Per-order latency: arrivals uniform over each frame's
+        # accumulation window (ending at its publish) at the sustained
+        # rate; completion = the frame's resolve+publish time.
+        offs = (np.arange(frame_n, dtype=np.float64)[::-1] + 1) / rate
+        lat = np.concatenate(
+            [d - (p - offs) for p, d in zip(pub_t, done_t)]
+        )
+        p50, p99, p999 = np.percentile(lat, [50, 99, 99.9])
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        f"order->publish latency, {frame_n}-order frames, "
+                        f"mixed stream, pipeline depth {PIPE}, {S} symbols"
+                    ),
+                    "value": round(p99 * 1e3, 1),
+                    "unit": "ms p99",
+                    "throughput_orders_per_sec": round(rate),
+                    "p50_ms": round(p50 * 1e3, 1),
+                    "p99_ms": round(p99 * 1e3, 1),
+                    "p999_ms": round(p999 * 1e3, 1),
+                }
+            )
+        )
+
+
+def grpc_main():
+    """--grpc: the measured gRPC front door — the real OrderGateway served
+    over a real channel, driven by the pipelined doorder client (a
+    separate process), with the FrameBatcher bridging requests into
+    ORDER frames for the pipelined frame consumer (the production
+    single-binary topology: client process | gateway+consumer process).
+
+    NOTE on this host: ONE CPU core — the client process, the gRPC
+    server threads, and the consumer timeshare it, so the number is the
+    single-core capacity of the whole front door, not the gateway's
+    parallel ceiling. The reference's only ingest is this path
+    (main.go:22-64); it publishes no numbers to compare against."""
+    check = "--check" in sys.argv
+    import subprocess
+
+    import jax
+
+    _enable_jax_cache()
+    if check:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from gome_tpu.bus import MemoryQueue, QueueBus
+    from gome_tpu.engine import BookConfig
+    from gome_tpu.engine.orchestrator import MatchEngine
+    from gome_tpu.service.batcher import FrameBatcher
+    from gome_tpu.service.consumer import OrderConsumer
+    from gome_tpu.service.gateway import OrderGateway
+
+    N = int(os.environ.get("SVC_GRPC_ORDERS", 4_096 if check else 131_072))
+    S = int(os.environ.get("SVC_SYMBOLS", 64 if check else 1_024))
+    CAP = int(os.environ.get("SVC_CAP", 64 if check else 256))
+    PIPE = int(os.environ.get("SVC_PIPELINE", 2))
+    BATCH = int(os.environ.get("SVC_GRPC_BATCH", 4_096))
+    CONC = int(os.environ.get("SVC_GRPC_CONCURRENCY", 128))
+
+    engine = MatchEngine(
+        config=BookConfig(cap=CAP, max_fills=16, dtype=jnp.int32),
+        n_slots=S,
+        max_t=32,
+        kernel="pallas",
+    )
+    bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    consumer = OrderConsumer(
+        engine, bus, batch_n=64, batch_wait_s=0.001, match_wire="frame",
+        pipeline_depth=PIPE,
+    )
+    batcher = FrameBatcher(bus.order_queue, max_n=BATCH, max_wait_s=0.005)
+    gateway = OrderGateway(
+        bus, accuracy=8, mark=engine.mark, batcher=batcher
+    )
+
+    from concurrent import futures
+
+    import grpc as _grpc
+
+    from gome_tpu.api.service import add_order_servicer
+
+    server = _grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+    add_order_servicer(server, gateway)
+    port = server.add_insecure_port("127.0.0.1:0")
+    assert port != 0
+    server.start()
+
+    def run_client(n, seed):
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "gome_tpu.clients.doorder",
+                f"127.0.0.1:{port}", str(n), str(CONC), str(S),
+                "0.995", "1.005", "4", str(seed),
+            ],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    # Warmup: compile every shape off the clock.
+    consumer.start()
+    run_client(min(N, 4 * BATCH) + 1, seed=1)
+    deadline = time.monotonic() + 300
+    while bus.order_queue.committed() < bus.order_queue.end_offset() or len(
+        consumer._pipe or ()
+    ):
+        batcher.flush()
+        time.sleep(0.02)
+        assert time.monotonic() < deadline, "warmup drain stalled"
+
+    # Timed: client start -> every order matched and published.
+    ev_skip = bus.match_queue.end_offset()
+    c0 = time.process_time()
+    t0 = time.perf_counter()
+    stats = run_client(N + 1, seed=2)
+    batcher.flush()
+    deadline = time.monotonic() + 600
+    while bus.order_queue.committed() < bus.order_queue.end_offset() or len(
+        consumer._pipe or ()
+    ):
+        batcher.flush()
+        time.sleep(0.005)
+        assert time.monotonic() < deadline, "timed drain stalled"
+    elapsed = time.perf_counter() - t0
+    server_cpu = time.process_time() - c0
+    consumer.stop()
+    server.stop(0)
+
+    from gome_tpu.bus.colwire import decode_event_frame
+
+    n_events = sum(
+        len(decode_event_frame(m.body))
+        for m in bus.match_queue.read_from(ev_skip, 1 << 30)
+    )
+    rate = N / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "gRPC-inclusive throughput: doorder client (pipelined, "
+                    f"concurrency {CONC}, separate process) -> real "
+                    f"OrderGateway -> FrameBatcher({BATCH}) -> frame "
+                    f"consumer -> matchOrder; {S} symbols, single-core "
+                    "host (client+server+consumer timeshare)"
+                ),
+                "value": round(rate),
+                "unit": "orders/sec",
+                "vs_baseline": round(rate / 1_000_000, 3),
+            }
+        )
+    )
+    print(
+        f"# client-side rate {stats['orders_per_s']:.0f}/s "
+        f"(ok={stats['ok']} rejected={stats['rejected']}) | end-to-end "
+        f"{rate:.0f}/s over {elapsed:.2f}s | events={n_events} | server "
+        f"process CPU {server_cpu:.2f}s -> "
+        f"{N / max(server_cpu, 1e-9) / 1e3:.0f}K orders/sec/core "
+        "(gateway handlers + batcher + consumer combined)",
         file=sys.stderr,
     )
 
@@ -727,6 +1140,10 @@ def service_sharded_main(n_shards: int):
 def main():
     if "--service-consumer" in sys.argv:
         return _shard_consumer_main()
+    if "--latency" in sys.argv:
+        return latency_main()
+    if "--grpc" in sys.argv:
+        return grpc_main()
     if "--service" in sys.argv:
         if "--shards" in sys.argv:
             n = int(sys.argv[sys.argv.index("--shards") + 1])

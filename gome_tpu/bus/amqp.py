@@ -102,10 +102,18 @@ def read_exact(sock: socket.socket, n: int) -> bytes:
     return out
 
 
+#: Hard upper bound on any incoming frame payload, regardless of the
+#: negotiated frame-max: a corrupt/hostile size field must fail the
+#: connection loudly, not allocate gigabytes.
+MAX_FRAME_SIZE = 16 << 20
+
+
 def read_frame(sock: socket.socket):
     """-> (type, channel, payload)."""
     hdr = read_exact(sock, 7)
     ftype, channel, size = struct.unpack(">BHI", hdr)
+    if size > MAX_FRAME_SIZE:
+        raise ConnectionError(f"AMQP frame size {size} exceeds sanity bound")
     payload = read_exact(sock, size) if size else b""
     end = read_exact(sock, 1)
     if end[0] != FRAME_END:
@@ -160,12 +168,23 @@ class AmqpQueue(Queue, _Waitable):
         self._frame_max = 131072
         self._pending_deliver: tuple | None = None
 
+        self._heartbeat = 0
         self._sock = socket.create_connection(
             (host, port), timeout=connect_timeout_s
         )
         try:
             self._sock.settimeout(None)
             self._handshake(username, password, vhost)
+            if self._heartbeat:
+                # Inbound-silence bound: a peer quiet for 2 intervals is
+                # dead (the spec's expiry rule); recv then times out and
+                # the read loop fails the connection loudly.
+                self._sock.settimeout(2.0 * self._heartbeat)
+                threading.Thread(
+                    target=self._heartbeat_loop,
+                    name=f"amqp-hb-{name}",
+                    daemon=True,
+                ).start()
             self._reader = threading.Thread(
                 target=self._read_loop, name=f"amqp-{name}", daemon=True
             )
@@ -214,10 +233,17 @@ class AmqpQueue(Queue, _Waitable):
         class_id, method_id = struct.unpack_from(">HH", payload, 0)
         if (class_id, method_id) != (10, 30):
             raise ConnectionError("expected Connection.Tune")
-        channel_max, frame_max, _hb = struct.unpack_from(">HIH", payload, 4)
+        channel_max, frame_max, hb = struct.unpack_from(">HIH", payload, 4)
         self._frame_max = min(frame_max or 131072, 131072)
+        # Heartbeat negotiation: accept the server's proposal (0 disables).
+        # A server that proposes heartbeats WILL drop silent connections
+        # (~2 intervals), so an idle publisher must send them — and we in
+        # turn treat >2 intervals of inbound silence as a dead peer (the
+        # read timeout below), instead of blocking forever on a TCP
+        # connection whose other end is gone.
+        self._heartbeat = hb
         tune_ok = method(
-            10, 31, struct.pack(">HIH", channel_max, self._frame_max, 0)
+            10, 31, struct.pack(">HIH", channel_max, self._frame_max, hb)
         )
         self._sock.sendall(frame(FRAME_METHOD, 0, tune_ok))
         open_ = method(10, 40, shortstr(vhost) + shortstr("") + bytes([0]))
@@ -231,6 +257,10 @@ class AmqpQueue(Queue, _Waitable):
         """Send a method on channel 1 and block for the expected reply
         (dispatched by the reader thread)."""
         with self._rpc_lock:
+            if self._closed:
+                raise ConnectionError(
+                    f"AMQP connection is closed (rpc {expect})"
+                )
             self._rpc_expect = expect
             self._rpc_event.clear()
             with self._lock:
@@ -239,12 +269,40 @@ class AmqpQueue(Queue, _Waitable):
                 raise ConnectionError(f"AMQP rpc timeout waiting for {expect}")
             reply = self._rpc_reply
             self._rpc_expect = None
+            if reply is None:  # reader died while we waited
+                raise ConnectionError(
+                    f"AMQP connection failed while waiting for {expect}"
+                )
             return reply
+
+    def _heartbeat_loop(self) -> None:
+        """Outbound heartbeats at half the negotiated interval (idle
+        publishers would otherwise be dropped by a heartbeat-enforcing
+        broker). Any frame counts as liveness traffic per spec, but
+        unconditional heartbeats are simpler and always sufficient."""
+        hb = frame(FRAME_HEARTBEAT, 0, b"")
+        while not self._closed:
+            time.sleep(self._heartbeat / 2.0)
+            if self._closed:
+                return
+            try:
+                with self._lock:
+                    if self._closed:
+                        return
+                    self._sock.sendall(hb)
+            except OSError:
+                return
 
     def _read_loop(self) -> None:
         try:
             while not self._closed:
-                ftype, channel, payload = read_frame(self._sock)
+                try:
+                    ftype, channel, payload = read_frame(self._sock)
+                except socket.timeout:
+                    raise ConnectionError(
+                        f"AMQP heartbeat expired: no traffic from peer in "
+                        f"{2 * self._heartbeat}s"
+                    ) from None
                 if ftype == FRAME_HEARTBEAT:
                     continue
                 if ftype == FRAME_METHOD:
@@ -266,6 +324,19 @@ class AmqpQueue(Queue, _Waitable):
                                 frame(FRAME_METHOD, 0, method(10, 51))
                             )
                         raise ConnectionError("broker closed the connection")
+                    if (class_id, method_id) == (20, 40):  # Channel.Close
+                        # Server killed our (only) channel — acknowledge,
+                        # then fail the queue loudly: every later op
+                        # raises instead of publishing into a dead
+                        # channel. (Previously this was silently ignored.)
+                        code, = struct.unpack_from(">H", payload, 4)
+                        with self._lock:
+                            self._sock.sendall(
+                                frame(FRAME_METHOD, channel, method(20, 41))
+                            )
+                        raise ConnectionError(
+                            f"broker closed the channel (code {code})"
+                        )
                     continue  # unsolicited method we don't care about
                 if ftype == FRAME_HEADER and self._pending_deliver:
                     (size,) = struct.unpack_from(">Q", payload, 4)
@@ -283,6 +354,10 @@ class AmqpQueue(Queue, _Waitable):
         except (ConnectionError, OSError):
             if not self._closed:
                 self._closed = True
+            # Fail any in-flight RPC NOW (it would otherwise block its
+            # full timeout against a connection that is already dead).
+            self._rpc_reply = None
+            self._rpc_event.set()
             self._notify_publish()  # wake any poll_batch waiter
 
     def _complete_delivery(self) -> None:

@@ -66,6 +66,7 @@ class _Connection:
         self.consuming: list[str] = []
         self._next_tag = 1
         self._pending_pub: tuple | None = None  # (queue, bytearray, [size])
+        self._publishes = 0  # fault-mode accounting
 
     def send(self, data: bytes) -> None:
         with self.wlock:
@@ -90,7 +91,7 @@ class _Connection:
                 + shortstr(queue),
             )
             parts = [frame(FRAME_METHOD, 1, deliver)] + content_frames(
-                1, body, 131072
+                1, body, self.broker.frame_max
             )
             self.send(b"".join(parts))
 
@@ -110,6 +111,14 @@ class _Connection:
                 + longstr(b"en_US"),
             )
             self.send(frame(FRAME_METHOD, 0, start))
+            if self.broker.heartbeat and not self.broker.mute_heartbeats:
+                threading.Thread(
+                    target=self._heartbeat_loop, daemon=True
+                ).start()
+            if self.broker.heartbeat:
+                # Enforce like RabbitMQ: a peer silent for ~2 intervals is
+                # dead. (Heartbeat frames from the client count.)
+                self.sock.settimeout(2.0 * self.broker.heartbeat + 0.5)
             while not self.closed:
                 ftype, channel, payload = read_frame(self.sock)
                 if ftype == FRAME_METHOD:
@@ -123,7 +132,7 @@ class _Connection:
                     self._pending_pub[1].extend(payload)
                     if len(self._pending_pub[1]) >= self._pending_pub[2][0]:
                         self._finish_publish()
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, socket.timeout):
             pass
         finally:
             self.closed = True
@@ -140,7 +149,14 @@ class _Connection:
             off = skip_table(buf, off)
             _mech, off = read_shortstr(buf, off)
             _resp, off = read_longstr(buf, off)
-            tune = method(10, 30, struct.pack(">HIH", 2047, 131072, 0))
+            tune = method(
+                10,
+                30,
+                struct.pack(
+                    ">HIH", 2047, self.broker.frame_max,
+                    self.broker.heartbeat,
+                ),
+            )
             self.send(frame(FRAME_METHOD, 0, tune))
         elif (class_id, method_id) == (10, 31):  # TuneOk
             pass
@@ -167,6 +183,30 @@ class _Connection:
             off += 2  # reserved
             _ex, off = read_shortstr(buf, off)
             rkey, off = read_shortstr(buf, off)
+            self._publishes += 1
+            if self._publishes == self.broker.close_abruptly_on_publish:
+                # Fault mode: the broker process dies mid-stream — no
+                # Close method, just a dead socket (kill -9 equivalent).
+                self.sock.close()
+                self.closed = True
+                return
+            if self._publishes == self.broker.channel_close_on_publish:
+                # Fault mode: server-initiated Channel.Close (e.g. 404
+                # NOT_FOUND / resource error) instead of accepting.
+                self.send(
+                    frame(
+                        FRAME_METHOD,
+                        channel,
+                        method(
+                            20,
+                            40,
+                            struct.pack(">H", 404)
+                            + shortstr("NOT_FOUND - fault injection")
+                            + struct.pack(">HH", 60, 40),
+                        ),
+                    )
+                )
+                return
             self._pending_pub = (rkey, bytearray(), [0])
         elif (class_id, method_id) == (60, 20):  # Basic.Consume
             off += 2
@@ -192,14 +232,53 @@ class _Connection:
         self._pending_pub = None
         self.broker._publish(qname, bytes(body))
 
+    def _heartbeat_loop(self) -> None:
+        hb = frame(8, 0, b"")  # FRAME_HEARTBEAT
+        while not self.closed:
+            import time
+
+            time.sleep(self.broker.heartbeat / 2.0)
+            if self.closed:
+                return
+            try:
+                self.send(hb)
+            except OSError:
+                return
+
 
 class FakeBroker:
     """Threaded localhost AMQP broker. start() binds an ephemeral port
-    (.port); stop() closes everything."""
+    (.port); stop() closes everything.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    Fault modes (protocol-strictness testing — behaviors a well-behaved
+    fake never produces but a real broker/network does):
+      heartbeat       — propose N-second heartbeats in Tune and ENFORCE
+                        them (silent peers are dropped after ~2N);
+      mute_heartbeats — with heartbeat set, the broker never sends its
+                        own (clients must detect the silence and fail);
+      frame_max       — propose a small frame size (content must split);
+      channel_close_on_publish — the Nth Basic.Publish draws a
+                        server-initiated Channel.Close(404);
+      close_abruptly_on_publish — the Nth Basic.Publish kills the socket
+                        with no Close handshake (broker crash)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        heartbeat: int = 0,
+        mute_heartbeats: bool = False,
+        frame_max: int = 131072,
+        channel_close_on_publish: int | None = None,
+        close_abruptly_on_publish: int | None = None,
+    ):
         self.host = host
         self.port = port
+        self.heartbeat = heartbeat
+        self.mute_heartbeats = mute_heartbeats
+        self.frame_max = frame_max
+        self.channel_close_on_publish = channel_close_on_publish
+        self.close_abruptly_on_publish = close_abruptly_on_publish
         self._server: socket.socket | None = None
         self._lock = threading.Lock()
         self._queues: dict[str, _BrokerQueue] = {}

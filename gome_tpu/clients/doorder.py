@@ -28,21 +28,29 @@ def load_client(
     seed: int | None = None,
     kind: int = 0,
     concurrency: int = 1,
+    symbols: list[str] | None = None,
+    price_lo: float = 0.01,
+    price_hi: float = 1.0,
+    decimals: int = 2,
 ) -> dict:
     """Send n-1 orders (the reference's serial loop at concurrency=1; higher
     values pipeline that many in-flight requests over one HTTP/2 channel —
     the serial client measures round-trip latency, not server capacity).
+    Defaults reproduce doorder.go:38-47 exactly; `symbols` (random pick per
+    order) and the price band exist for sustained benches, where the
+    reference's full-range prices would pile depth without crossing.
     Returns {sent, ok, rejected, elapsed_s, orders_per_s}."""
     rng = random.Random(seed)
+    pick = symbols or [symbol]
 
     def requests():  # lazy: O(window) client memory at any n
         for i in range(1, n):  # doorder.go:37 loop bounds
             yield pb.OrderRequest(
                 uuid=uuid,
                 oid=str(i),
-                symbol=symbol,
+                symbol=pick[rng.randrange(len(pick))] if symbols else symbol,
                 transaction=rng.randrange(2),  # doorder.go:39-44
-                price=round(rng.uniform(0.01, 1.0), 2),
+                price=round(rng.uniform(price_lo, price_hi), decimals),
                 volume=round(rng.uniform(0.01, 1.0), 2),
                 kind=kind,
             )
@@ -80,17 +88,30 @@ def load_client(
 
 
 def main(argv=None):
+    import json
     import sys
 
     argv = sys.argv[1:] if argv is None else argv
     target = argv[0] if argv else "127.0.0.1:8088"
     n = int(argv[1]) if len(argv) > 1 else 2000
     concurrency = int(argv[2]) if len(argv) > 2 else 1
-    stats = load_client(target, n=n, concurrency=concurrency)
-    print(
-        f"sent={stats['sent']} ok={stats['ok']} rejected={stats['rejected']} "
-        f"elapsed={stats['elapsed_s']:.2f}s rate={stats['orders_per_s']:.0f}/s"
-    )
+    n_symbols = int(argv[3]) if len(argv) > 3 else 0
+    kwargs = {}
+    if n_symbols:
+        kwargs["symbols"] = [f"sym{i}" for i in range(n_symbols)]
+    if len(argv) > 4:  # crossing price band for sustained benches
+        if len(argv) < 7:
+            sys.exit(
+                "usage: doorder TARGET [N [CONCURRENCY [N_SYMBOLS "
+                "[PRICE_LO PRICE_HI DECIMALS [SEED]]]]]"
+            )
+        kwargs["price_lo"] = float(argv[4])
+        kwargs["price_hi"] = float(argv[5])
+        kwargs["decimals"] = int(argv[6])
+    if len(argv) > 7:
+        kwargs["seed"] = int(argv[7])
+    stats = load_client(target, n=n, concurrency=concurrency, **kwargs)
+    print(json.dumps(stats))
 
 
 if __name__ == "__main__":

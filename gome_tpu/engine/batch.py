@@ -180,6 +180,18 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _merge_buf_floor(dst: dict, src) -> None:
+    """Raise per-class buffer floors: src is {pow2 class: slots} or an
+    int (interpreted as a floor for its own pow2 class)."""
+    items = (
+        src.items() if isinstance(src, dict)
+        else [(_next_pow2(max(int(src), 64)), int(src))]
+    )
+    for b, v in items:
+        v = _next_pow2(max(int(v), 64))
+        dst[b] = max(dst.get(b, 0), v)
+
+
 def splice_outs(outs, overrides):
     """Build the `outs_at(field, rows, ts)` accessor decode_grid_columnar
     needs: reads StepOutput columns at packed (row, t) coordinates and
@@ -310,12 +322,17 @@ class BatchEngine:
         self._dense_rows_floor = 8
         self._dense_t_floor = 8
         # Compaction-buffer ratchets (frames._compact_sizes): grow-only
-        # fetch-buffer sizes. Both rise to the largest pow2 class any
-        # frame has needed; the fills floor additionally grows when a
-        # frame's fill count overflows its buffer (the exact-path
+        # fetch-buffer sizes, keyed by the grid's pow2 op-count class. A
+        # frame can contain grids of wildly different sizes (a Zipf flow
+        # packs one 256K-op full grid plus a train of small deep dense
+        # grids), so a single global floor would make every small grid
+        # fetch the big grid's buffer; per-class floors keep each grid's
+        # transfer proportional to its ops while still pinning compiled
+        # shapes within a class. The fills floor additionally grows when
+        # a grid's fill count overflows its buffer (the exact-path
         # fallback keeps that safe).
-        self._fills_buf_floor = 0
-        self._cancels_buf_floor = 0
+        self._fills_buf_floor: dict[int, int] = {}
+        self._cancels_buf_floor: dict[int, int] = {}
         if mesh is not None:
             # Every place n_slots can be set (init, growth, restore) must
             # produce a mesh multiple; enforcing the two static bounds here
@@ -327,6 +344,7 @@ class BatchEngine:
                         f"{mesh.size}"
                     )
         self._sharded_steppers: dict = {}  # BookConfig -> jitted step
+        self._sharded_dense_steppers: dict = {}  # BookConfig -> dense step
         self.books = self._place(init_books(config, n_slots))
         from .nativehost import make_interner
 
@@ -422,6 +440,13 @@ class BatchEngine:
                 drop[i] = True
         return drop
 
+    # Buffer-floor helpers (shared with frames._compact_sizes): floors
+    # are {pow2 op-class: slot count}; an int means "this size, in its
+    # own class".
+    @staticmethod
+    def _buf_class(n: int) -> int:
+        return _next_pow2(max(n, 64))
+
     def prewarm_geometry(
         self,
         rows_floor: int | None = None,
@@ -431,12 +456,14 @@ class BatchEngine:
     ) -> None:
         """Pre-set the grow-only shape ratchets to known steady-state
         values (each rounds up to a power of two; existing floors never
-        shrink). Every distinct compiled shape costs a trace+compile the
-        first time it appears; a deployment that knows its flow's geometry
-        (from a previous run or a staging soak) pre-warms here so every
-        shape compiles during warmup instead of mid-traffic. Purely a
-        performance knob — untouched ratchets grow on demand exactly as
-        before."""
+        shrink). fills_buf/cancels_buf accept an int (a floor for its own
+        pow2 op-class) or a {pow2 op-class: slots} dict as returned by
+        geometry_floors(). Every distinct compiled shape costs a
+        trace+compile the first time it appears; a deployment that knows
+        its flow's geometry (from a previous run or a staging soak)
+        pre-warms here so every shape compiles during warmup instead of
+        mid-traffic. Purely a performance knob — untouched ratchets grow
+        on demand exactly as before."""
         if rows_floor is not None:
             self._dense_rows_floor = max(
                 self._dense_rows_floor, _next_pow2(max(rows_floor, 8))
@@ -446,59 +473,91 @@ class BatchEngine:
                 self._dense_t_floor, _next_pow2(max(t_floor, 8))
             )
         if fills_buf is not None:
-            self._fills_buf_floor = max(
-                self._fills_buf_floor, _next_pow2(max(fills_buf, 64))
-            )
+            _merge_buf_floor(self._fills_buf_floor, fills_buf)
         if cancels_buf is not None:
-            self._cancels_buf_floor = max(
-                self._cancels_buf_floor, _next_pow2(max(cancels_buf, 64))
-            )
+            _merge_buf_floor(self._cancels_buf_floor, cancels_buf)
 
     def geometry_floors(self) -> dict:
         """The current grow-only shape ratchets (see prewarm_geometry) —
         what a warmup loop watches to decide the flow's compiled shapes
         have stabilized, and what a deployment records to pre-warm the
-        next process."""
+        next process. The buffer floors are {pow2 op-class: slots} dicts;
+        everything is copied (safe to hold across further frames)."""
         return dict(
             rows_floor=self._dense_rows_floor,
             t_floor=self._dense_t_floor,
-            fills_buf=self._fills_buf_floor,
-            cancels_buf=self._cancels_buf_floor,
+            fills_buf=dict(self._fills_buf_floor),
+            cancels_buf=dict(self._cancels_buf_floor),
             cap=self.config.cap,
         )
 
-    def _grid_geometry(self, live: np.ndarray):
+    def _grid_geometry(self, live: np.ndarray, first: bool = True):
         """Grid geometry decision, shared by the object packer and the
         frame path (engine.frames): when the batch touches few of the
         provisioned lanes, pack a compact grid over just the live lanes
-        (row -> lane indirection, executed by dense_batch_step); rows
-        bucket to powers of two (min 8 — the Pallas kernel's sublane
-        floor; sentinel padding rows are free) to bound compile shapes.
-        The full [n_slots, *] grid remains for wide batches and under a
-        mesh (a cross-shard gather would need collectives).
+        (row -> lane indirection, executed by dense_batch_step /
+        parallel.mesh.sharded_dense_step); rows bucket to powers of two
+        (min 8 — the Pallas kernel's sublane floor; sentinel padding rows
+        are free) to bound compile shapes.
 
-        Returns (use_dense, n_rows, lane_ids); lane_ids is None for full
-        grids."""
-        use_dense = (
-            self.dense
-            and self.mesh is None
-            and len(live) > 0
-            and max(8, _next_pow2(len(live))) < self.n_slots
-        )
-        if not use_dense:
-            return False, self.n_slots, None
-        # Grow-only row bucket ("ratchet"): live-lane counts hovering at a
-        # pow2 boundary would otherwise flip the compiled grid shape frame
-        # to frame — and one fresh XLA compile costs more than thousands
-        # of frames of matching. Sentinel padding rows are cheap; larger-
-        # than-needed grids are not (so the ratchet, not max shape).
-        n_rows = max(8, _next_pow2(len(live)), self._dense_rows_floor)
-        if n_rows >= self.n_slots:
-            return False, self.n_slots, None
-        self._dense_rows_floor = n_rows
-        lane_ids = np.full(n_rows, self.n_slots, np.int64)
-        lane_ids[: len(live)] = live
-        return True, n_rows, lane_ids
+        `first` marks the first dense grid of a frame's train. Only it
+        consults/advances the grow-only row ratchet: the train's DEEPER
+        grids (lanes outliving earlier grids' time axes — a Zipf flow
+        drains its hot lanes through a geometrically shrinking train)
+        use raw pow2 buckets, because pinning them to the first grid's
+        floor would run every tail grid at the head grid's width —
+        hundreds of times the live work. Their shapes converge to a
+        small set (the shrink is geometric), each compiled once.
+
+        Under a mesh the row axis is laid out PER SHARD: shard d's live
+        lanes occupy the contiguous row block [d*R_s, (d+1)*R_s), so the
+        standard symbol-axis sharding of the [D*R_s, T] grid hands each
+        chip exactly the rows naming its own lanes — the dense gather
+        stays shard-local and needs zero collectives (per-symbol key
+        isolation, ordernode.go:89-117). R_s buckets to the max per-shard
+        live count, so the dense win shrinks as skew concentrates on one
+        shard — which is the true cost surface on hardware.
+
+        Returns (use_dense, n_rows, lane_ids, row_of): lane_ids [n_rows]
+        GLOBAL lane ids with sentinel n_slots on padding rows (the device
+        step localizes under a mesh); row_of [n_slots] maps live lane ->
+        row (valid only at live positions). Both None for full grids."""
+        if not (self.dense and len(live) > 0):
+            return False, self.n_slots, None, None
+        floor = self._dense_rows_floor if first else 8
+        if self.mesh is None:
+            n_rows = max(8, _next_pow2(len(live)), floor)
+            if n_rows >= self.n_slots:
+                return False, self.n_slots, None, None
+            # Grow-only row bucket ("ratchet"): live-lane counts hovering
+            # at a pow2 boundary would otherwise flip the compiled grid
+            # shape frame to frame — and one fresh XLA compile costs more
+            # than thousands of frames of matching.
+            if first:
+                self._dense_rows_floor = n_rows
+            lane_ids = np.full(n_rows, self.n_slots, np.int64)
+            lane_ids[: len(live)] = live
+            rows_for_live = np.arange(len(live), dtype=np.int64)
+        else:
+            d = self.mesh.size
+            local = self.n_slots // d
+            shard = live // local  # live is sorted (np.unique upstream)
+            counts = np.bincount(shard, minlength=d)
+            r_s = max(8, _next_pow2(int(counts.max())), floor)
+            if r_s * d >= self.n_slots:
+                return False, self.n_slots, None, None
+            if first:
+                self._dense_rows_floor = r_s
+            n_rows = r_s * d
+            lane_ids = np.full(n_rows, self.n_slots, np.int64)
+            starts = np.zeros(d, np.int64)
+            np.cumsum(counts[:-1], out=starts[1:])
+            rank = np.arange(len(live), dtype=np.int64) - starts[shard]
+            rows_for_live = shard * r_s + rank
+            lane_ids[rows_for_live] = live
+        row_of = np.empty(self.n_slots, np.int64)
+        row_of[live] = rows_for_live
+        return True, n_rows, lane_ids, row_of
 
     def _admit_lane_range(self, lane: int, l: int, h: int) -> None:
         """Admit the ADD-limit price range [l, h] into `lane`'s grow-only
@@ -768,9 +827,9 @@ class BatchEngine:
             np.unique(lanes[~drop]) if bool((~drop).any())
             else np.zeros(0, np.int64)
         )
-        use_dense, n_rows, lane_ids = self._grid_geometry(live)
+        use_dense, n_rows, lane_ids, row_of = self._grid_geometry(live)
         if use_dense:
-            row = np.searchsorted(live, lanes)
+            row = row_of[lanes]
             t_grid = min(
                 max(_next_pow2(max(level.values())), self._dense_t_floor),
                 max(self.dense_t_max, self.max_t),
@@ -971,11 +1030,38 @@ class BatchEngine:
 
     def _step(self, books: BookState, ops: DeviceOp, lane_ids=None):
         """Run one [R, T] grid with the configured kernel. lane_ids selects
-        the dense gather/scatter step (compact grid over live lanes; never
-        under a mesh — the packer guarantees that). The Pallas path
+        the dense gather/scatter step (compact grid over live lanes; under
+        a mesh the rows are laid out per shard and the gather runs inside
+        shard_map — parallel.mesh.sharded_dense_step). The Pallas path
         requires S % block_s == 0 (n_slots growth keeps powers of two) and
         interprets off-TPU; escalation re-runs (lane_scan) stay on the scan
         path — they are rare and per-lane."""
+        if lane_ids is not None and self.mesh is not None:
+            from ..parallel.mesh import shard_batch, sharded_dense_step
+
+            # Localize: global lane -> shard-local index (each chip's row
+            # block names only its own lanes, so lane % local IS the
+            # local index); sentinel rows map to `local` (out of range on
+            # every chip: gathered as zero books, dropped by the scatter).
+            local = self.n_slots // self.mesh.size
+            ids_np = np.asarray(lane_ids)
+            ids_local = np.where(
+                ids_np >= self.n_slots, local, ids_np % local
+            ).astype(np.int32)
+            stepper = self._sharded_dense_steppers.get(self.config)
+            if stepper is None:
+                stepper = sharded_dense_step(
+                    self.config,
+                    self.mesh,
+                    kernel=self.kernel,
+                    pallas_interpret=self._pallas_interpret,
+                )
+                self._sharded_dense_steppers[self.config] = stepper
+            return stepper(
+                books,
+                shard_batch(self.mesh, jnp.asarray(ids_local)),
+                shard_batch(self.mesh, ops),
+            )
         if lane_ids is not None:
             ids = jnp.asarray(lane_ids, jnp.int32)
             if self.kernel == "pallas":

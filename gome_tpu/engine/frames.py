@@ -192,24 +192,28 @@ def pack_frame_grids(eng: BatchEngine, a: dict) -> list[tuple]:
         if not bool(active.any()):
             break
         live = np.unique(lanes[active])
-        use_dense, n_rows, lane_ids = eng._grid_geometry(live)
+        first = t_off == 0
+        use_dense, n_rows, lane_ids, row_of = eng._grid_geometry(
+            live, first=first
+        )
         remaining_t = t - t_off
         if use_dense:
-            # Dense O(1) lane -> row map (searchsorted over the full frame
-            # costs ~10x more at frame shape).
-            row_of = np.empty(eng.n_slots, np.int64)
-            row_of[live] = np.arange(len(live), dtype=np.int64)
+            # O(1) lane -> row map from the geometry decision (mesh-aware:
+            # rows group per shard so the dense gather stays shard-local).
             rows = row_of[lanes]
-            # Depth ratchet, like the row bucket in _grid_geometry: a
-            # compiled shape must not oscillate with per-frame depth.
+            # Depth ratchet, like the row bucket in _grid_geometry — and
+            # like it, only the train's FIRST dense grid consults or
+            # advances the floor (a deep floor would stretch every small
+            # tail grid to the full depth; see _grid_geometry).
             t_grid = min(
                 max(
                     _next_pow2(int(remaining_t[active].max()) + 1),
-                    eng._dense_t_floor,
+                    eng._dense_t_floor if first else 8,
                 ),
                 max(eng.dense_t_max, eng.max_t),
             )
-            eng._dense_t_floor = t_grid
+            if first:
+                eng._dense_t_floor = t_grid
         else:
             rows = lanes
             t_grid = eng.max_t
@@ -555,8 +559,9 @@ def resolve_frame(eng: BatchEngine, pend: PendingFrame):
         n_fills_seen = int(totals[0])
         tripped = False
         if n_fills_seen > len(fetched[1]["src"]):
-            eng._fills_buf_floor = max(
-                eng._fills_buf_floor, _next_pow2(n_fills_seen)
+            cls = eng._buf_class(n_ops)
+            eng._fills_buf_floor[cls] = max(
+                eng._fills_buf_floor.get(cls, 0), _next_pow2(n_fills_seen)
             )
             tripped = True
         if (
@@ -578,9 +583,9 @@ def resolve_frame(eng: BatchEngine, pend: PendingFrame):
 def apply_frame_fast(eng: BatchEngine, cols: dict):
     """Production hot path, single-frame form: submit + resolve with one
     overlapped fetch; falls back — transactionally — to the exact path
-    when any device budget tripped. Semantics identical to apply_frame."""
-    if eng.mesh is not None:
-        return apply_frame(eng, cols)
+    when any device budget tripped. Semantics identical to apply_frame.
+    Runs under a mesh too: the compaction is elementwise + one cumsum
+    over the sharded record axis, and the fetch gathers per-chip blocks."""
     try:
         pend = submit_frame(eng, cols)
     except Exception:
@@ -613,21 +618,27 @@ def _compact_sizes(eng, n_ops: int, n_dels: int) -> tuple[int, int]:
                 bound for its cancel events; a pure-ADD stream fetches a
                 64-slot stub instead of an n_ops-sized buffer of zeros).
 
-    Both sizes are themselves grow-only ratchets: a frame that lands in a
-    larger pow2 class raises the floor, so later smaller frames reuse the
-    same compiled shape instead of oscillating across classes (each
-    distinct (fills, cancels) pair is a fresh compile — data-dependent
-    sizes would recompile whenever a frame's DEL count straddled a pow2
-    boundary). A frame whose FILL count overflows its buffer
-    transactionally re-runs on the exact path (resolve_frame) AND raises
-    the floor, so that costs one slow frame per ratchet step, not a
-    recurring tax; cancel events can never overflow (cancels <= n_dels by
-    construction, step.py cancel_found). Deployments that know their flow
-    pre-warm the floors (BatchEngine.prewarm_geometry)."""
-    fills = max(_next_pow2(max(n_ops, 64)), eng._fills_buf_floor)
-    cancels = max(_next_pow2(max(n_dels, 64)), eng._cancels_buf_floor)
-    eng._fills_buf_floor = fills
-    eng._cancels_buf_floor = cancels
+    Both sizes are grow-only ratchets KEYED BY the grid's pow2 op-count
+    class (BatchEngine._fills_buf_floor): within a class, a grid that
+    needs a larger buffer raises the floor so later grids reuse one
+    compiled shape instead of oscillating (data-dependent sizes would
+    recompile whenever a DEL count straddled a pow2 boundary); across
+    classes, floors stay independent so a frame mixing one huge full
+    grid with a train of small dense grids (Zipf flows) does not fetch
+    the big grid's buffer for every small one. A grid whose FILL count
+    overflows its buffer transactionally re-runs on the exact path
+    (resolve_frame) AND raises its class's floor, so that costs one slow
+    frame per ratchet step, not a recurring tax; cancel events can never
+    overflow (cancels <= n_dels by construction, step.py cancel_found).
+    Deployments that know their flow pre-warm the floors
+    (BatchEngine.prewarm_geometry)."""
+    cls = eng._buf_class(n_ops)
+    fills = max(cls, eng._fills_buf_floor.get(cls, 0))
+    cancels = max(
+        _next_pow2(max(n_dels, 64)), eng._cancels_buf_floor.get(cls, 0)
+    )
+    eng._fills_buf_floor[cls] = fills
+    eng._cancels_buf_floor[cls] = cancels
     return fills, cancels
 
 

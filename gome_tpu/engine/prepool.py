@@ -321,6 +321,17 @@ class NativePrePool:
         uuid_data, uuid_offs = self._packed(cols["uuids"])
         sym_idx = np.ascontiguousarray(cols["symbol_idx"], np.uint32)
         uuid_idx = np.ascontiguousarray(cols["uuid_idx"], np.uint32)
+        # The C pass indexes the offset tables unchecked; a frame whose
+        # index column exceeds its dictionary must fail HERE, loudly.
+        if n and (
+            int(sym_idx.max()) >= len(cols["symbols"])
+            or int(uuid_idx.max()) >= len(cols["uuids"])
+        ):
+            raise ValueError(
+                "ORDER frame index column exceeds its dictionary "
+                f"(symbols {len(cols['symbols'])}, uuids "
+                f"{len(cols['uuids'])})"
+            )
         oids = np.ascontiguousarray(cols["oids"])
         keep = np.empty(n, np.uint8) if mode == 0 else None
         existed = sel if sel is not None else (

@@ -210,9 +210,22 @@ def pallas_batch_step(
     """
     s, t_len = ops.action.shape
     if block_t is None:
-        # Largest divisor of T that is <= 64: bounds VMEM without imposing
-        # any divisibility constraint on callers' time depths.
-        block_t = min(t_len, 64)
+        # Largest divisor of T that fits the paged-block VMEM budget:
+        # per time step the kernel pages op (8 rows) + 5 record (K rows
+        # each) + scalar (8 rows) blocks of block_s lanes, double-buffered
+        # by the pipeline. Mosaic's scoped-VMEM stack is 16 MB and the
+        # resident book tiles take ~10*block_s*2*cap*4 (in+out), so give
+        # the paged blocks ~5 MB. (Found the hard way: cap=256 K=16
+        # block_s=128 at block_t=64 allocates 17.5 MB and fails to
+        # compile.)
+        per_t = (
+            block_s
+            * (8 + 5 * config.max_fills + 8)
+            * jnp.dtype(config.dtype).itemsize
+            * 2
+        )
+        budget_t = max(int((5 << 20) // per_t), 1)
+        block_t = min(t_len, 64, budget_t)
         while t_len % block_t:
             block_t -= 1
     if s % block_s != 0:

@@ -30,6 +30,20 @@ from ..engine.batch import batch_step
 SYM_AXIS = "sym"
 
 
+def _shard_map_fn(mesh: Mesh):
+    """shard_map bound to `mesh` (check_vma off where supported:
+    pallas_call's ShapeDtypeStruct outputs carry no varying-mesh-axis
+    annotation, and the bodies here are embarrassingly parallel)."""
+    try:
+        from jax import shard_map as _shard_map
+
+        return functools.partial(_shard_map, mesh=mesh, check_vma=False)
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        return functools.partial(_shard_map, mesh=mesh)
+
+
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """1-D mesh over the symbol axis. n_devices must divide the lane count
     used with it."""
@@ -81,19 +95,7 @@ def sharded_batch_step(
         use_pallas = not interpret or pallas_interpret
 
     if use_pallas:
-        try:
-            from jax import shard_map as _shard_map
-
-            # check_vma off: pallas_call's ShapeDtypeStruct outputs carry
-            # no varying-mesh-axis annotation; the body is embarrassingly
-            # parallel (no collectives), so the check buys nothing here.
-            shard_map = functools.partial(
-                _shard_map, mesh=mesh, check_vma=False
-            )
-        except ImportError:  # older jax
-            from jax.experimental.shard_map import shard_map as _shard_map
-
-            shard_map = functools.partial(_shard_map, mesh=mesh)
+        shard_map = _shard_map_fn(mesh)
         from ..ops import (
             default_block_s,
             interpret_block_s,
@@ -125,6 +127,87 @@ def sharded_batch_step(
     return jax.jit(
         stepper,
         in_shardings=(sharding, sharding),
+        out_shardings=(sharding, sharding),
+    )
+
+
+def sharded_dense_step(
+    config: BookConfig,
+    mesh: Mesh,
+    kernel: str = "scan",
+    pallas_interpret: bool = False,
+):
+    """Per-shard dense gather/scatter step under the mesh — the multi-chip
+    form of engine.batch.dense_batch_step/dense_kernel_step.
+
+    Skewed (Zipf) flow is exactly where dense packing matters, and per-
+    symbol key isolation makes it embarrassingly partitionable
+    (ordernode.go:89-117): each shard gathers only its LOCAL live lanes,
+    so the whole step needs zero collectives. The packer
+    (BatchEngine._grid_geometry) lays the compact row axis out as
+    [D * R_s] — shard d's rows occupy the contiguous block
+    [d*R_s, (d+1)*R_s) and name only lanes that shard owns — so the
+    standard symbol-axis sharding hands every chip its own [R_s] block of
+    rows, its own [S/D] block of books, and the step inside shard_map is
+    the SAME gather -> scan/kernel -> scatter a single-chip dense grid
+    runs.
+
+    Returns a jitted fn(books, local_ids, ops) with shardings pinned;
+    local_ids are shard-local lane indices (sentinel >= S/D on padding
+    rows — gathered as zero books, dropped by the scatter)."""
+    sharding = symbol_sharding(mesh)
+    shard_map = _shard_map_fn(mesh)
+    from ..engine.batch import _lane_scan_impl
+
+    use_pallas = False
+    interpret = False
+    if kernel == "pallas":
+        from ..ops import pallas_available
+
+        interpret = not pallas_available(config.dtype)
+        use_pallas = not interpret or pallas_interpret
+
+    def per_chip(books, ids, ops):
+        import jax.numpy as jnp
+
+        sub = jax.tree.map(
+            lambda a: jnp.take(a, ids, axis=0, mode="fill", fill_value=0),
+            books,
+        )
+        block = None
+        if use_pallas:
+            from ..ops import default_block_s, interpret_block_s
+
+            block = default_block_s(ids.shape[0], config.cap)
+            if block is None and interpret:
+                block = interpret_block_s(ids.shape[0])
+        if block is not None:
+            from ..ops import pallas_batch_step
+
+            sub, outs = pallas_batch_step(
+                config, sub, ops, block_s=block, interpret=interpret
+            )
+        else:
+            sub, outs = jax.vmap(
+                lambda b, o: _lane_scan_impl(config, b, o)
+            )(sub, ops)
+        new_books = jax.tree.map(
+            lambda a, s: a.at[ids].set(s, mode="drop"), books, sub
+        )
+        return new_books, outs
+
+    spec = P(SYM_AXIS)
+
+    def stepper(books: BookState, ids, ops: DeviceOp):
+        return shard_map(
+            per_chip,
+            in_specs=(spec, spec, spec),
+            out_specs=(spec, spec),
+        )(books, ids, ops)
+
+    return jax.jit(
+        stepper,
+        in_shardings=(sharding, sharding, sharding),
         out_shardings=(sharding, sharding),
     )
 

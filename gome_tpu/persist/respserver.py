@@ -121,11 +121,25 @@ class FakeRedisServer:
     def _serve_conn(self, conn: socket.socket) -> None:
         reader = _Reader(conn)
         out = bytearray()
+
+        def read_command():
+            """RESP-array command, or a real-Redis-parity INLINE command
+            (a bare space-separated line — redis-cli/telnet send these;
+            the RESP client never does, so this is exactly the kind of
+            input an in-repo fake would otherwise never see)."""
+            while len(reader._buf) - reader._pos < 1:
+                reader._fill()
+            if reader._buf[reader._pos : reader._pos + 1] == b"*":
+                return reader.read_reply()
+            return reader._readline().split()
+
         try:
             while not self._stop.is_set():
-                args = reader.read_reply()  # commands ARE RESP arrays
+                args = read_command()
                 if not isinstance(args, list):
                     break
+                if not args:  # empty inline line: ignore, like Redis
+                    continue
                 out.clear()
                 self._dispatch([_s(a) for a in args], out)
                 # Drain any further fully-buffered (pipelined) commands

@@ -1,0 +1,127 @@
+"""The gateway->frame batching bridge: per-request gRPC traffic becomes
+columnar ORDER frames.
+
+The reference's gateway publishes one JSON document per request
+(main.go:46-48 via engine.go:35-44); at frame-consumer rates that wire
+costs more than matching. This bridge is the production answer to "who
+aggregates requests into frames": the gRPC handlers submit accepted
+orders here (after marking the pre-pool, exactly like their per-order
+publish), and the bridge flushes one binary ORDER frame (bus.colwire) to
+the doOrder queue when either
+
+  * `max_n` orders accumulated (throughput bound), or
+  * `max_wait_s` elapsed since the oldest buffered order (latency bound —
+    this IS the batching latency cost, and it is configurable: a frame
+    closes at most max_wait_s after the order that opened it).
+
+Arrival order is preserved (one lock-guarded buffer; the flusher swaps
+the whole buffer out under the lock), so the per-symbol FIFO invariant
+(SURVEY §5.2) holds through the bridge. Consumers need no changes: the
+order consumer already sniffs frames vs JSON per message, so a deployment
+can switch the gateway to the bridge mid-stream.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..bus.colwire import encode_orders
+from ..types import Order
+
+
+class FrameBatcher:
+    """Order accumulator flushing ORDER frames to a queue.
+
+    submit() is thread-safe (gRPC handler threads call it concurrently);
+    flushes happen on the submitting thread when the size bound trips, or
+    on the background deadline thread for the latency bound. close()
+    flushes the remainder and stops the deadline thread."""
+
+    def __init__(self, queue, max_n: int = 4096, max_wait_s: float = 0.002):
+        if max_n < 1:
+            raise ValueError("max_n must be >= 1")
+        self.queue = queue
+        self.max_n = max_n
+        self.max_wait_s = max_wait_s
+        self._buf: list[Order] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop_event = threading.Event()
+        self._stop = False
+        self._oldest: float | None = None  # monotonic time of buffer head
+        self._thread = threading.Thread(
+            target=self._deadline_loop, name="frame-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, order: Order) -> None:
+        """Buffer one accepted order; flush if the size bound tripped.
+
+        The encode+publish happens UNDER the lock: a swapped-out batch
+        published outside it could be overtaken by the next batch (a
+        descheduled flusher), inverting price-time priority across
+        frames. Holding the lock serializes frames in arrival order; the
+        cost is submitters briefly blocking behind one frame encode
+        (~1 ms at 4K orders), which is the batching backpressure."""
+        with self._lock:
+            if not self._buf:
+                import time
+
+                self._oldest = time.monotonic()
+                self._wake.set()
+            self._buf.append(order)
+            if len(self._buf) >= self.max_n:
+                self._flush_locked()
+
+    def flush(self) -> int:
+        """Flush whatever is buffered now; returns the count flushed."""
+        with self._lock:
+            return self._flush_locked()
+
+    def _flush_locked(self) -> int:
+        batch = self._swap_locked()
+        if batch:
+            self.queue.publish(encode_orders(batch))
+        return len(batch)
+
+    def _swap_locked(self) -> list[Order]:
+        batch, self._buf = self._buf, []
+        self._oldest = None
+        return batch
+
+    def _deadline_loop(self) -> None:
+        import time
+
+        while True:
+            self._wake.wait()
+            if self._stop:
+                return
+            with self._lock:
+                oldest = self._oldest
+                if oldest is None:
+                    self._wake.clear()
+                    continue
+            delay = oldest + self.max_wait_s - time.monotonic()
+            if delay > 0:
+                # Interruptible: close() sets the stop event, so a large
+                # max_wait_s never pins the thread (or close's join).
+                if self._stop_event.wait(delay):
+                    return
+            with self._lock:
+                # Flush only if the head is still overdue (a size-bound
+                # flush may have raced and restarted the window).
+                if (
+                    self._oldest is not None
+                    and time.monotonic() >= self._oldest + self.max_wait_s
+                ):
+                    self._flush_locked()
+                if self._oldest is None:
+                    self._wake.clear()
+
+    def close(self) -> None:
+        """Flush the remainder and stop the deadline thread."""
+        self._stop = True
+        self._stop_event.set()
+        self._wake.set()
+        self._thread.join(timeout=5)
+        self.flush()

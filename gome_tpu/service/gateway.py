@@ -58,18 +58,29 @@ class OrderGateway:
         mark=None,
         match_feed=None,
         max_volume: int | None = None,
+        batcher=None,
     ):
         """mark: callable(Order) recording the pre-pool entry — the
         MatchEngine.mark bound method in single-binary mode. match_feed:
         MatchFeed for SubscribeMatches (optional). max_volume: per-order lot
         ceiling enforced at the edge (int32 engines pass LOT_MAX32 so an
         oversized order is rejected with code 3 here, like volume<=0,
-        instead of raising inside the consumer batch)."""
+        instead of raising inside the consumer batch). batcher: a
+        service.batcher.FrameBatcher — accepted orders then leave as
+        columnar ORDER frames (size/deadline bounded) instead of one JSON
+        document per request; admission/marking semantics are unchanged."""
         self._bus = bus
         self._accuracy = accuracy
         self._mark = mark or (lambda order: None)
         self._match_feed = match_feed
         self._max_volume = max_volume
+        self._batcher = batcher
+
+    def _emit(self, order: Order) -> None:
+        if self._batcher is not None:
+            self._batcher.submit(order)
+        else:
+            self._bus.order_queue.publish(encode_order(order))
 
     def DoOrder(self, request: pb.OrderRequest, context) -> pb.OrderResponse:
         try:
@@ -86,7 +97,7 @@ class OrderGateway:
         except ValueError as e:
             return pb.OrderResponse(code=3, message=f"rejected: {e}")
         self._mark(order)  # pre-pool before queueing (main.go:44-45)
-        self._bus.order_queue.publish(encode_order(order))
+        self._emit(order)
         # main.go:49: unconditional success; matching outcome arrives async.
         return pb.OrderResponse(code=0, message="order accepted")
 
@@ -96,8 +107,9 @@ class OrderGateway:
         except ValueError as e:
             return pb.OrderResponse(code=3, message=f"rejected: {e}")
         # No pre-pool mark (main.go:54-64); the consumer clears it so a
-        # still-queued ADD dies (engine.go:88-90, SURVEY §2.3.3).
-        self._bus.order_queue.publish(encode_order(order))
+        # still-queued ADD dies (engine.go:88-90, SURVEY §2.3.3). Cancels
+        # ride the same batcher so the DEL-after-ADD order is preserved.
+        self._emit(order)
         return pb.OrderResponse(code=0, message="cancel accepted")
 
     def SubscribeMatches(self, request: pb.SubscribeRequest, context):

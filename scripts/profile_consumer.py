@@ -57,17 +57,22 @@ rng = np.random.default_rng(7)
 symbols = [f"sym{i}" for i in range(S)]
 FRAME = min(FRAME, N)
 # Same warm-until-stable + margin-pinning as bench.py service_main:
-# profile only steady-state frames.
-n_warm, oid0 = _svc_warmup(
-    engine, consumer, bus, rng, FRAME, S, symbols, oid0=1
-)
+# profile only steady-state frames. PROF_MIXED=1 profiles the mixed
+# (headline) stream instead of the clean one.
+oid_box = [1]
+if os.environ.get("PROF_MIXED"):
+    flow = bench._MixedFlow(rng, S)
+    make_frame = lambda: flow.frame(FRAME)
+else:
+    def make_frame():
+        cols = _svc_columns(rng, FRAME, S, oid_box[0])
+        oid_box[0] += FRAME
+        return cols
+
+n_warm = _svc_warmup(engine, consumer, bus, make_frame, symbols)
 print(f"warm_frames={n_warm}", file=sys.stderr)
 
-frames_cols = []
-for start in range(0, N, FRAME):
-    n = min(FRAME, N - start)
-    frames_cols.append(_svc_columns(rng, n, S, oid0))
-    oid0 += n
+frames_cols = [make_frame() for _ in range(-(-N // FRAME))]
 engine_frames.FETCH_SECONDS = 0.0
 
 for cols in frames_cols:

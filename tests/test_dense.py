@@ -156,12 +156,12 @@ def test_grid_geometry_ratchets_are_grow_only():
     eng = BatchEngine(BookConfig(cap=16, max_fills=4), n_slots=128, max_t=8)
     shapes = []
     for live_n in (9, 17, 9, 33, 9, 17):
-        use_dense, n_rows, _ = eng._grid_geometry(
+        use_dense, n_rows, _, _ = eng._grid_geometry(
             np.arange(live_n, dtype=np.int64)
         )
         assert use_dense
         shapes.append(n_rows)
     assert shapes == [16, 32, 32, 64, 64, 64]  # never shrinks
     # Ratchet capped below n_slots: growing past it falls back to full.
-    use_dense, n_rows, _ = eng._grid_geometry(np.arange(127, dtype=np.int64))
+    use_dense, n_rows, _, _ = eng._grid_geometry(np.arange(127, dtype=np.int64))
     assert not use_dense and n_rows == eng.n_slots

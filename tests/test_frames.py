@@ -356,3 +356,5 @@ def test_lane_growth_survives_rollback_retry():
     assert len(fills) == 200
     assert eng.n_slots >= 4  # growth stuck after the retry
     assert eng.stats.fills == 200
+    # The sweep grid's op class (64) ratcheted its fills floor past 200.
+    assert eng.geometry_floors()["fills_buf"][64] == 256

@@ -1,0 +1,226 @@
+"""Protocol-strictness tests for the from-scratch RESP and AMQP clients.
+
+Both clients normally talk to fakes written by the same author
+(persist/respserver.py, bus/fakebroker.py) — a shared encoding quirk would
+pass every functional test. These tests inject the behaviors the fakes
+never produce in healthy runs (mid-pipeline death, protocol errors,
+heartbeat expiry, server-initiated channel close, tiny negotiated frame
+sizes) and pin that the clients fail LOUDLY (typed exceptions, bounded
+time) and recoverably (a fresh connection works; no hangs)."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from gome_tpu.bus.amqp import AmqpQueue
+from gome_tpu.bus.fakebroker import FakeBroker
+from gome_tpu.persist.resp import RespClient, RespError
+from gome_tpu.persist.respserver import FakeRedisServer
+
+
+# --- scripted RESP server -------------------------------------------------
+
+
+class _ScriptedResp:
+    """One-connection TCP server that answers each received buffer flush
+    with the next canned byte string (then optionally dies)."""
+
+    def __init__(self, replies, close_after: int | None = None):
+        self.replies = list(replies)
+        self.close_after = close_after
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        conn, _ = self._srv.accept()
+        with conn:
+            served = 0
+            while self.replies:
+                data = conn.recv(65536)
+                if not data:
+                    return
+                conn.sendall(self.replies.pop(0))
+                served += 1
+                if self.close_after is not None and served >= self.close_after:
+                    return  # abrupt close
+
+    def stop(self):
+        self._srv.close()
+
+
+class TestRespFaults:
+    def test_server_close_mid_pipeline_raises(self):
+        # 3 commands pipelined; the server answers only one reply's worth
+        # of bytes then closes. The client must raise ConnectionError, not
+        # hang or fabricate replies.
+        srv = _ScriptedResp([b":1\r\n"], close_after=1)
+        try:
+            c = RespClient(port=srv.port, timeout_s=5)
+            with pytest.raises(ConnectionError):
+                c.pipeline([("HDEL", "k", "a"), ("HDEL", "k", "b"),
+                            ("HDEL", "k", "c")])
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_malformed_reply_type_raises_resp_error(self):
+        srv = _ScriptedResp([b"?what\r\n"])
+        try:
+            c = RespClient(port=srv.port, timeout_s=5)
+            with pytest.raises(RespError, match="malformed"):
+                c.execute_command("PING")
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_partial_bulk_then_close_raises(self):
+        # Bulk header promises 100 bytes; only 5 arrive before close.
+        srv = _ScriptedResp([b"$100\r\nhello"], close_after=1)
+        try:
+            c = RespClient(port=srv.port, timeout_s=5)
+            with pytest.raises(ConnectionError):
+                c.execute_command("GET", "k")
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_pipeline_errors_in_place_and_connection_survives(self):
+        # Against the real fake server: an unknown command mid-pipeline
+        # returns a RespError IN PLACE; the commands after it still get
+        # their replies and the connection keeps working.
+        with FakeRedisServer() as srv:
+            c = RespClient(port=srv.port)
+            replies = c.pipeline(
+                [("HSET", "h", "f", "1"), ("NOSUCH",), ("HDEL", "h", "f")]
+            )
+            assert replies[0] == 1
+            assert isinstance(replies[1], RespError)
+            assert replies[2] == 1
+            assert c.ping()
+            c.close()
+
+    def test_fake_server_accepts_inline_commands(self):
+        # Real-Redis parity the RESP client never exercises: telnet-style
+        # inline commands (redis-cli's bare lines).
+        with FakeRedisServer() as srv:
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+            try:
+                s.sendall(b"PING\r\nHSET h f 1\r\nHEXISTS h f\r\n")
+                got = b""
+                deadline = time.monotonic() + 5
+                while got.count(b"\r\n") < 3:
+                    assert time.monotonic() < deadline
+                    got += s.recv(4096)
+                assert got == b"+PONG\r\n:1\r\n:1\r\n"
+            finally:
+                s.close()
+
+
+# --- AMQP fault modes -----------------------------------------------------
+
+
+class TestAmqpFaults:
+    def test_heartbeats_keep_idle_connection_alive(self):
+        # Broker proposes 1s heartbeats and ENFORCES them: an idle client
+        # that never sent heartbeats would be dropped within ~2.5s. Ours
+        # must survive >3 idle seconds and still round-trip.
+        broker = FakeBroker(heartbeat=1).start()
+        try:
+            q = AmqpQueue("hb", port=broker.port)
+            time.sleep(3.2)  # idle: only heartbeats flow
+            q.publish(b"alive")
+            msgs = q.read_from(0, 10)
+            assert [m.body for m in msgs] == [b"alive"]
+            q.close()
+        finally:
+            broker.stop()
+
+    def test_silent_broker_trips_heartbeat_expiry(self):
+        # Broker negotiates 1s heartbeats but never sends traffic (fault
+        # mode): the client must declare the peer dead in bounded time and
+        # fail the next publish loudly instead of blocking forever.
+        broker = FakeBroker(heartbeat=1, mute_heartbeats=True).start()
+        try:
+            q = AmqpQueue("dead", port=broker.port)
+            deadline = time.monotonic() + 10
+            while not q._closed:
+                assert time.monotonic() < deadline, "expiry never detected"
+                time.sleep(0.1)
+            with pytest.raises(ConnectionError):
+                q.publish(b"x")
+        finally:
+            broker.stop()
+
+    def test_small_negotiated_frame_max_splits_and_reassembles(self):
+        broker = FakeBroker(frame_max=4096).start()
+        try:
+            q = AmqpQueue("big", port=broker.port)
+            assert q._frame_max == 4096
+            body = bytes(range(256)) * 80  # 20480 bytes > 4 frames
+            q.publish(body)
+            msgs = q.read_from(0, 10)
+            assert len(msgs) == 1 and msgs[0].body == body
+            q.close()
+        finally:
+            broker.stop()
+
+    def test_server_initiated_channel_close_fails_loudly(self):
+        broker = FakeBroker(channel_close_on_publish=2).start()
+        try:
+            q = AmqpQueue("chan", port=broker.port)
+            q.publish(b"ok")
+            # The 2nd publish draws Channel.Close; the failure surfaces on
+            # that call or the next (the close races the local send).
+            with pytest.raises(ConnectionError):
+                deadline = time.monotonic() + 10
+                while True:
+                    assert time.monotonic() < deadline, "never failed"
+                    q.publish(b"boom")
+                    time.sleep(0.05)
+        finally:
+            broker.stop()
+
+    def test_abrupt_broker_death_mid_stream(self):
+        # kill -9 shape: the socket just dies. Publish must raise in
+        # bounded time and a FRESH connection to a healthy broker works
+        # (recoverability is reconnection, not limping on).
+        broker = FakeBroker(close_abruptly_on_publish=3).start()
+        try:
+            q = AmqpQueue("crash", port=broker.port)
+            q.publish(b"a")
+            q.publish(b"b")
+            with pytest.raises(ConnectionError):
+                deadline = time.monotonic() + 10
+                while True:
+                    assert time.monotonic() < deadline, "never failed"
+                    q.publish(b"x")
+                    time.sleep(0.05)
+        finally:
+            broker.stop()
+        broker2 = FakeBroker().start()
+        try:
+            q2 = AmqpQueue("crash", port=broker2.port)
+            q2.publish(b"again")
+            assert [m.body for m in q2.read_from(0, 10)] == [b"again"]
+            q2.close()
+        finally:
+            broker2.stop()
+
+    def test_oversized_frame_header_rejected(self):
+        # A corrupt size field must fail the connection, not allocate GBs.
+        from gome_tpu.bus.amqp import read_frame
+
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack(">BHI", 1, 0, 1 << 30))
+            b.settimeout(5)
+            with pytest.raises(ConnectionError, match="sanity"):
+                read_frame(b)
+        finally:
+            a.close()
+            b.close()

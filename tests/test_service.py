@@ -160,3 +160,171 @@ def test_service_parity_vs_oracle():
         )
     ]
     assert got == expected
+
+
+class TestFrameBatcher:
+    """The gateway->frame batching bridge (service.batcher): per-request
+    gRPC traffic leaves as columnar ORDER frames (SURVEY L4's missing
+    production story: who aggregates, at what latency cost)."""
+
+    def _orders(self, n, start=0):
+        from gome_tpu.types import Action, Order, OrderType, Side
+
+        return [
+            Order(
+                uuid="u", oid=f"o{start + i}", symbol="s", side=Side.BUY,
+                price=100, volume=1, action=Action.ADD,
+                order_type=OrderType.LIMIT,
+            )
+            for i in range(n)
+        ]
+
+    def test_size_bound_flush_preserves_order(self):
+        from gome_tpu.bus import MemoryQueue
+        from gome_tpu.bus.colwire import decode_order_frame
+        from gome_tpu.service.batcher import FrameBatcher
+
+        q = MemoryQueue("doOrder")
+        b = FrameBatcher(q, max_n=16, max_wait_s=60)
+        for o in self._orders(40):
+            b.submit(o)
+        try:
+            # Two full frames flushed by size; 8 remain buffered.
+            msgs = q.read_from(0, 10)
+            assert len(msgs) == 2
+            oids = []
+            for m in msgs:
+                cols = decode_order_frame(m.body)
+                assert cols["n"] == 16
+                oids.extend(x.decode() for x in cols["oids"])
+            assert oids == [f"o{i}" for i in range(32)]
+            assert b.flush() == 8
+            cols = decode_order_frame(q.read_from(2, 10)[0].body)
+            assert [x.decode() for x in cols["oids"]] == [
+                f"o{i}" for i in range(32, 40)
+            ]
+        finally:
+            b.close()
+
+    def test_deadline_flush(self):
+        import time
+
+        from gome_tpu.bus import MemoryQueue
+        from gome_tpu.service.batcher import FrameBatcher
+
+        q = MemoryQueue("doOrder")
+        b = FrameBatcher(q, max_n=1 << 20, max_wait_s=0.05)
+        try:
+            for o in self._orders(5):
+                b.submit(o)
+            deadline = time.monotonic() + 5
+            while q.end_offset() == 0:
+                assert time.monotonic() < deadline, "deadline never flushed"
+                time.sleep(0.01)
+            from gome_tpu.bus.colwire import decode_order_frame
+
+            assert decode_order_frame(q.read_from(0, 1)[0].body)["n"] == 5
+        finally:
+            b.close()
+
+    def test_close_flushes_remainder(self):
+        from gome_tpu.bus import MemoryQueue
+        from gome_tpu.service.batcher import FrameBatcher
+
+        q = MemoryQueue("doOrder")
+        b = FrameBatcher(q, max_n=100, max_wait_s=60)
+        for o in self._orders(7):
+            b.submit(o)
+        b.close()
+        assert q.end_offset() == 1
+
+
+class TestGatewayBatcherEndToEnd:
+    """Real channel -> OrderGateway(batcher=...) -> ORDER frames -> frame
+    consumer: the gRPC-inclusive ingest path, oracle-checked."""
+
+    def test_grpc_to_frames_to_events(self):
+        from concurrent import futures
+
+        from gome_tpu.api.service import add_order_servicer
+        from gome_tpu.bus import MemoryQueue, QueueBus
+        from gome_tpu.bus.colwire import decode_event_frame, is_frame
+        from gome_tpu.engine import BookConfig
+        from gome_tpu.engine.orchestrator import MatchEngine
+        from gome_tpu.service.batcher import FrameBatcher
+        from gome_tpu.service.consumer import OrderConsumer
+        from gome_tpu.service.gateway import OrderGateway
+
+        engine = MatchEngine(
+            config=BookConfig(cap=32, max_fills=8), n_slots=8, max_t=8
+        )
+        bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+        batcher = FrameBatcher(bus.order_queue, max_n=8, max_wait_s=60)
+        gw = OrderGateway(bus, accuracy=8, mark=engine.mark, batcher=batcher)
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        add_order_servicer(server, gw)
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        oracle = OracleEngine()
+        try:
+            with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+                stub = OrderStub(ch)
+                reqs = [
+                    ("u1", "a1", pb.SALE, 1.00, 5.0),
+                    ("u2", "b1", pb.BUY, 1.00, 3.0),
+                    ("u1", "a2", pb.SALE, 1.01, 2.0),
+                    ("u2", "b2", pb.BUY, 1.01, 4.0),
+                ]
+                for uuid, oid, side, price, vol in reqs:
+                    r = stub.DoOrder(
+                        pb.OrderRequest(
+                            uuid=uuid, oid=oid, symbol="s",
+                            transaction=side, price=price, volume=vol,
+                        )
+                    )
+                    assert r.code == 0
+                # Cancel b2's remainder over gRPC too.
+                stub.DeleteOrder(
+                    pb.OrderRequest(
+                        uuid="u2", oid="b2", symbol="s",
+                        transaction=pb.BUY, price=1.01, volume=0,
+                    )
+                )
+            batcher.close()
+            # Everything left as ONE frame (5 ops < max_n after close).
+            msgs = bus.order_queue.read_from(0, 10)
+            assert len(msgs) == 1 and is_frame(msgs[0].body)
+            consumer = OrderConsumer(
+                engine, bus, batch_n=8, batch_wait_s=0, match_wire="frame"
+            )
+            consumer.drain()
+            got = []
+            for m in bus.match_queue.read_from(0, 100):
+                got.extend(decode_event_frame(m.body).to_results())
+            from gome_tpu.types import Action, Order, OrderType, Side
+            from gome_tpu.fixed import scale
+
+            expected = []
+            for uuid, oid, side, price, vol in reqs:
+                expected.extend(
+                    oracle.process(
+                        Order(
+                            uuid=uuid, oid=oid, symbol="s",
+                            side=Side(side), price=scale(price, 8),
+                            volume=scale(vol, 8), action=Action.ADD,
+                            order_type=OrderType.LIMIT,
+                        )
+                    )
+                )
+            expected.extend(
+                oracle.process(
+                    Order(
+                        uuid="u2", oid="b2", symbol="s", side=Side.BUY,
+                        price=scale(1.01, 8), volume=0, action=Action.DEL,
+                        order_type=OrderType.LIMIT,
+                    )
+                )
+            )
+            assert got == expected
+        finally:
+            server.stop(grace=None)
