@@ -264,7 +264,7 @@ class AmqpQueue(Queue, _Waitable):
             self._rpc_expect = expect
             self._rpc_event.clear()
             with self._lock:
-                self._sock.sendall(frame(FRAME_METHOD, 1, method_payload))
+                self._send(frame(FRAME_METHOD, 1, method_payload))
             if not self._rpc_event.wait(self.SYNC_WAIT_S):
                 raise ConnectionError(f"AMQP rpc timeout waiting for {expect}")
             reply = self._rpc_reply
@@ -274,6 +274,24 @@ class AmqpQueue(Queue, _Waitable):
                     f"AMQP connection failed while waiting for {expect}"
                 )
             return reply
+
+
+    def _send(self, data: bytes) -> None:
+        """All post-handshake writes go through here: a send that times
+        out (the heartbeat-expiry socket timeout governs sends too) or
+        fails leaves an unknown amount of a frame on the wire — the
+        connection's framing is unrecoverable, so it is marked closed and
+        the caller gets the documented ConnectionError, never a raw
+        socket.timeout followed by a desynced retry."""
+        try:
+            self._sock.sendall(data)
+        except (socket.timeout, OSError) as e:
+            self._closed = True
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            raise ConnectionError(f"AMQP send failed: {e}") from e
 
     def _heartbeat_loop(self) -> None:
         """Outbound heartbeats at half the negotiated interval (idle
@@ -289,7 +307,7 @@ class AmqpQueue(Queue, _Waitable):
                 with self._lock:
                     if self._closed:
                         return
-                    self._sock.sendall(hb)
+                    self._send(hb)
             except OSError:
                 return
 
@@ -414,7 +432,7 @@ class AmqpQueue(Queue, _Waitable):
             parts = [frame(FRAME_METHOD, 1, pub)] + content_frames(
                 1, body, self._frame_max
             )
-            self._sock.sendall(b"".join(parts))
+            self._send(b"".join(parts))
             off = self._published
             self._published += 1
             return off
@@ -452,7 +470,7 @@ class AmqpQueue(Queue, _Waitable):
                 ack = method(
                     60, 80, struct.pack(">QB", self._tags[offset - 1], 1)
                 )
-                self._sock.sendall(frame(FRAME_METHOD, 1, ack))
+                self._send(frame(FRAME_METHOD, 1, ack))
                 self._acked_through = offset
 
     def rollback(self, offset: int) -> None:
@@ -474,7 +492,7 @@ class AmqpQueue(Queue, _Waitable):
             # uncommitted, undropped middle — which must stay redeliverable.
             for tag in self._tags[offset:]:
                 ack = method(60, 80, struct.pack(">QB", tag, 0))
-                self._sock.sendall(frame(FRAME_METHOD, 1, ack))
+                self._send(frame(FRAME_METHOD, 1, ack))
             del self._buffer[offset:]
             del self._tags[offset:]
             self._published = min(self._published, offset)

@@ -180,6 +180,17 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _next_pow4(n: int) -> int:
+    """Coarser shape bucket for a frame's train grids: every distinct
+    compiled shape costs a trace, and the train's later grids see
+    stochastic live counts/depths — pow4 classes (8, 32, 128, ...) visit
+    4x fewer shapes for at most 4x padding on SMALL grids."""
+    p = 1
+    while p < n:
+        p *= 4
+    return p
+
+
 def _merge_buf_floor(dst: dict, src) -> None:
     """Raise per-class buffer floors: src is {pow2 class: slots} or an
     int (interpreted as a floor for its own pow2 class)."""
@@ -243,6 +254,7 @@ class EngineStats:
     device_calls: int = 0
     cap_escalations: int = 0
     fill_record_escalations: int = 0
+    frame_fallbacks: int = 0  # fast-path frames re-run on the exact path
     lane_growths: int = 0
 
 
@@ -525,8 +537,9 @@ class BatchEngine:
         if not (self.dense and len(live) > 0):
             return False, self.n_slots, None, None
         floor = self._dense_rows_floor if first else 8
+        bucket = _next_pow2 if first else _next_pow4
         if self.mesh is None:
-            n_rows = max(8, _next_pow2(len(live)), floor)
+            n_rows = max(8, bucket(len(live)), floor)
             if n_rows >= self.n_slots:
                 return False, self.n_slots, None, None
             # Grow-only row bucket ("ratchet"): live-lane counts hovering
@@ -543,7 +556,7 @@ class BatchEngine:
             local = self.n_slots // d
             shard = live // local  # live is sorted (np.unique upstream)
             counts = np.bincount(shard, minlength=d)
-            r_s = max(8, _next_pow2(int(counts.max())), floor)
+            r_s = max(8, bucket(int(counts.max())), floor)
             if r_s * d >= self.n_slots:
                 return False, self.n_slots, None, None
             if first:
@@ -830,11 +843,25 @@ class BatchEngine:
         use_dense, n_rows, lane_ids, row_of = self._grid_geometry(live)
         if use_dense:
             row = row_of[lanes]
+            from .frames import _REC_ELEM_BUDGET
+
+            # Depth budgeted against rows (record tensors are [T, K, R];
+            # see frames.pack_frame_grids for the rationale).
+            t_mem = max(
+                self.max_t,
+                _next_pow2(
+                    _REC_ELEM_BUDGET
+                    // max(n_rows * self.config.max_fills, 1)
+                    + 1
+                )
+                // 2,
+            )
             t_grid = min(
                 max(_next_pow2(max(level.values())), self._dense_t_floor),
                 max(self.dense_t_max, self.max_t),
+                t_mem,
             )
-            self._dense_t_floor = t_grid
+            self._dense_t_floor = max(self._dense_t_floor, t_grid)
         else:
             row = lanes
             t_grid = self.max_t
@@ -965,9 +992,11 @@ class BatchEngine:
         # insert was dropped — the book state is NOT what the sequential
         # semantics require, so grow the slot axis and replay the whole grid
         # from the snapshot (exact: active slots are a prefix; padding is
-        # invisible to matching). The required cap is bounded host-side
-        # before replaying — current resting count plus the ADDs packed into
-        # the lane — so escalation costs one replay, not a doubling loop.
+        # invisible to matching). The new cap targets the host-side bound
+        # (current resting count plus the ADDs packed into the lane) but
+        # grows at most 4x per replay — see the clamp below — so deep
+        # grids converge in a few exact replays instead of one wildly
+        # oversized jump.
         while True:
             new_books, outs = self._step(books_before, ops, lane_ids)
             self.stats.device_calls += 1
@@ -990,7 +1019,16 @@ class BatchEngine:
                     0,
                 )
             bound = int((row_counts + adds_per_row).max())
-            new_cap = _next_pow2(max(bound, self.config.cap + 1))
+            # The bound assumes EVERY packed ADD rests — with deep dense
+            # grids (thousands of ADDs on a hot row) that overshoots the
+            # true requirement by orders of magnitude, and cap is global
+            # across all S lanes (one 16K-cap escalation on a 10K-lane
+            # stack is gigabytes). Grow at most 4x per escalation: the
+            # replay loop converges in log4 steps to the smallest
+            # sufficient pow2, each step exact.
+            new_cap = _next_pow2(
+                max(min(bound, 4 * self.config.cap), self.config.cap + 1)
+            )
             if new_cap > self.max_cap:
                 raise CapacityError(
                     f"book cap escalation to {new_cap} exceeds max_cap="

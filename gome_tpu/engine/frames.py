@@ -24,7 +24,7 @@ Two execution strategies:
     round trip per grid.
   * `apply_frame_fast` — the production hot path: every grid of the frame
     is DISPATCHED back-to-back with a device-side event-compaction kernel
-    (compact_step_outputs) appended, then ONE async fetch resolves the
+    (compact_accum) appended, then ONE async fetch resolves the
     whole frame. The compaction reduces the transfer from O(S*T*K) record
     tensors (~500 B/order, seconds over a tunneled link) to O(events)
     (~30 B/order). If any device budget tripped (book overflow, record
@@ -52,7 +52,7 @@ import numpy as np
 FETCH_SECONDS = 0.0
 
 from ..types import Action, OrderType
-from .batch import BatchEngine, _next_pow2, splice_outs
+from .batch import BatchEngine, _next_pow2, _next_pow4, splice_outs
 from .book import GRID_I32_FIELDS, DeviceOp
 from .step import ACTION_ADD, LOT_MAX32
 
@@ -60,6 +60,12 @@ ACTION_DEL = int(Action.DEL)
 MARKET = int(OrderType.MARKET)
 
 _GRID_FIELDS = DeviceOp._fields  # one canonical field list + order
+
+#: Per-grid record-tensor element budget (T*K*R per record array; 5 record
+#: arrays x 4 B => 16M elements ~ 320 MB of step outputs). Bounds the
+#: rows-x-depth product of dense grids so deep time axes are reserved for
+#: few-row (hot-lane) grids.
+_REC_ELEM_BUDGET = 1 << 24
 
 
 def _lane_map(eng: BatchEngine, symbols) -> np.ndarray:
@@ -180,99 +186,152 @@ def _frame_arrays(eng: BatchEngine, cols: dict) -> dict:
     )
 
 
+@functools.lru_cache(maxsize=64)
+def _scatter_grid_fn(dtype_name: str, n_rows: int, t_grid: int):
+    """Jitted device-side grid builder for one (dtype, R, T) shape:
+    packed op columns [7, m_pad] + flat positions [m_pad] -> a padded
+    DeviceOp grid. The host uploads O(ops) bytes regardless of the
+    grid's occupancy — a Zipf train's deep tail grids are ~1% occupied,
+    and shipping their NOP padding over the device link cost more than
+    the matching itself. Padding columns carry flat == R*T and drop."""
+    dtype = jnp.dtype(dtype_name)
+    rt = n_rows * t_grid
+
+    @jax.jit
+    def scatter(cols, flat):
+        fields = {}
+        for i, name in enumerate(_GRID_FIELDS):
+            want = jnp.int32 if name in GRID_I32_FIELDS else dtype
+            fields[name] = (
+                jnp.zeros((rt,), want)
+                .at[flat]
+                .set(cols[i].astype(want), mode="drop")
+                .reshape(n_rows, t_grid)
+            )
+        return DeviceOp(**fields)
+
+    return scatter
+
+
 def pack_frame_grids(eng: BatchEngine, a: dict) -> list[tuple]:
     """Stage 2: split the frame into grids (lanes deeper than the grid's
-    time axis roll into the next grid — FIFO by construction) and scatter
-    the columns in. Returns [(ops, meta, lane_ids), ...]."""
+    time axis roll into the next grid — FIFO by construction), pack each
+    grid's ops as columns, and DISPATCH the device-side scatter that
+    rebuilds the padded grid on device. Returns [(ops, meta, lane_ids),
+    ...] with ops already device-resident.
+
+    The loop carries a SHRINKING active-op index set: each grid of the
+    train touches only the ops still alive at its time offset, so a
+    G-grid train (a Zipf flow draining hot lanes) costs O(sum of
+    survivors), not O(G * frame) — with 27 grids per frame the latter was
+    the consumer's dominant host cost."""
     lanes, keep, t = a["lanes"], a["keep"], a["t"]
     grids = []
     t_off = 0
-    while True:
-        active = keep & (t >= t_off)
-        if not bool(active.any()):
-            break
-        live = np.unique(lanes[active])
+    active_idx = np.nonzero(keep)[0]
+    t_sub = t[active_idx]
+    while len(active_idx):
+        live = np.unique(lanes[active_idx])
         first = t_off == 0
         use_dense, n_rows, lane_ids, row_of = eng._grid_geometry(
             live, first=first
         )
-        remaining_t = t - t_off
         if use_dense:
-            # O(1) lane -> row map from the geometry decision (mesh-aware:
-            # rows group per shard so the dense gather stays shard-local).
-            rows = row_of[lanes]
             # Depth ratchet, like the row bucket in _grid_geometry — and
             # like it, only the train's FIRST dense grid consults or
             # advances the floor (a deep floor would stretch every small
-            # tail grid to the full depth; see _grid_geometry).
+            # tail grid to the full depth; see _grid_geometry). Depth is
+            # additionally budgeted against the grid's ROW count: the
+            # step's record tensors are [T, K, R], so a wide grid must
+            # stay shallow (2048 rows x 8192 deep x K=16 is a 10+ GB
+            # allocation) while a few-row hot-lane tail can run
+            # dense_t_max deep — the same rows-vs-depth trade the device
+            # bench's packer applies.
+            t_mem = max(
+                eng.max_t,
+                _next_pow2(
+                    _REC_ELEM_BUDGET
+                    // max(n_rows * eng.config.max_fills, 1)
+                    + 1
+                )
+                // 2,
+            )
+            bucket_t = _next_pow2 if first else _next_pow4
             t_grid = min(
                 max(
-                    _next_pow2(int(remaining_t[active].max()) + 1),
+                    bucket_t(int(t_sub.max()) - t_off + 1),
                     eng._dense_t_floor if first else 8,
                 ),
                 max(eng.dense_t_max, eng.max_t),
+                t_mem,
             )
             if first:
-                eng._dense_t_floor = t_grid
+                # Grow-only; a mem-clamped wide grid leaves the floor for
+                # future narrower (deeper-capable) first grids.
+                eng._dense_t_floor = max(eng._dense_t_floor, t_grid)
         else:
-            rows = lanes
+            # Full grid: row == lane (identity map).
+            row_of = np.arange(eng.n_slots, dtype=np.int64)
             t_grid = eng.max_t
 
         from . import nativehost
 
+        in_window = t_sub < t_off + t_grid
+        m = int(np.count_nonzero(in_window))
+        m_pad = _next_pow4(max(m, 64))
         if nativehost.available():
-            # Selection + all 7 grid scatters + the 11 meta extractions in
-            # ONE native pass (the numpy form below is ~20 separate
-            # mask/scatter passes over frame-sized arrays).
-            grid, meta = nativehost.pack_grid(
-                a, rows, t_off, t_grid, n_rows, eng.config.dtype,
-                MARKET, ACTION_ADD,
+            # Column pack + the 11 meta extractions in ONE native pass
+            # (the numpy form below is ~15 separate mask passes).
+            cols, flat, meta = nativehost.pack_grid(
+                a, active_idx, row_of, t_off, t_grid, n_rows, m_pad,
+                eng.config.dtype, MARKET, ACTION_ADD,
             )
-            grids.append((DeviceOp(**grid), meta, lane_ids))
-            t_off += t_grid
-            continue
-
-        packed = active & (remaining_t < t_grid)
-        grid = {
-            name: np.zeros(
-                (n_rows, t_grid),
-                np.int32
-                if name in GRID_I32_FIELDS
-                else np.dtype(eng.config.dtype),
+        else:
+            sel = active_idx[in_window]
+            dt = np.dtype(eng.config.dtype)
+            cols = np.empty((7, m_pad), dt)
+            flat = np.full(m_pad, n_rows * t_grid, np.int32)
+            pr, pt = row_of[lanes[sel]], t[sel] - t_off
+            flat[:m] = (pr * t_grid + pt).astype(np.int32)
+            is_mkt = (a["kind"][sel] == MARKET) & (
+                a["action"][sel] == ACTION_ADD
             )
-            for name in _GRID_FIELDS
-        }
-        pr, pt = rows[packed], remaining_t[packed]
-        flat = pr * t_grid + pt  # one index computation for all 7 scatters
-        is_mkt = (a["kind"][packed] == MARKET) & (
-            a["action"][packed] == ACTION_ADD
-        )
-        put = lambda name, val: grid[name].reshape(-1).__setitem__(flat, val)
-        put("action", a["action"][packed])
-        put("side", a["side"][packed])
-        put("is_market", is_mkt)
-        put("price", np.where(
-            is_mkt, 0, a["price"][packed] - a["bases"][packed]
-        ))
-        put("volume", a["volume"][packed])
-        put("oid", a["oid_ids"][packed])
-        put("uid", a["uid_ids"][packed])
+            for i, (name, val) in enumerate(
+                (
+                    ("action", a["action"][sel]),
+                    ("side", a["side"][sel]),
+                    ("is_market", is_mkt),
+                    ("price", np.where(
+                        is_mkt, 0, a["price"][sel] - a["bases"][sel]
+                    )),
+                    ("volume", a["volume"][sel]),
+                    ("oid", a["oid_ids"][sel]),
+                    ("uid", a["uid_ids"][sel]),
+                )
+            ):
+                cols[i, :m] = val
+            meta = {
+                "lane": lanes[sel],
+                "row": pr,
+                "t": pt,
+                "arrival": sel.astype(np.int64),
+                "action": a["action"][sel],
+                "side": a["side"][sel],
+                "is_market": is_mkt.astype(np.int64),
+                "price": a["price"][sel],
+                "price_base": a["bases"][sel],
+                "oid_id": a["oid_ids"][sel],
+                "uid_id": a["uid_ids"][sel],
+            }
+        ops = _scatter_grid_fn(
+            np.dtype(eng.config.dtype).name, n_rows, t_grid
+        )(cols, flat)
+        grids.append((ops, meta, lane_ids))
 
-        meta = {
-            "lane": lanes[packed],
-            "row": pr,
-            "t": pt,
-            "arrival": np.nonzero(packed)[0].astype(np.int64),
-            "action": a["action"][packed],
-            "side": a["side"][packed],
-            "is_market": is_mkt.astype(np.int64),
-            "price": a["price"][packed],
-            "price_base": a["bases"][packed],
-            "oid_id": a["oid_ids"][packed],
-            "uid_id": a["uid_ids"][packed],
-        }
-        grids.append((DeviceOp(**grid), meta, lane_ids))
         t_off += t_grid
+        alive = t_sub >= t_off
+        active_idx = active_idx[alive]
+        t_sub = t_sub[alive]
     return grids
 
 
@@ -337,71 +396,12 @@ def process_frame(eng: BatchEngine, cols: dict):
 # --- device-side event compaction (the fast path) -----------------------
 
 
-@functools.partial(jax.jit, static_argnums=(0, 2, 3))
-def compact_step_outputs(config, outs, e_fills: int, e_cancels: int):
-    """Compact a grid's StepOutput into flat per-event record arrays ON
-    DEVICE: the host then fetches O(events) instead of O(R*T*K) tensors —
-    ~30 B/order instead of ~500, which is the difference between the
-    matchOrder feed keeping pace with the device and the host link being
-    the ceiling.
-
-    Returns (totals, fills, cancels):
-      totals = [n_fills_events, n_cancel_events, book_overflows,
-                max_n_fills] (int32)
-      fills  = dict of [e_fills] arrays: src (flat r*T*K + t*K + k, i32),
-               fill_price, fill_qty, maker_oid, maker_uid, maker_volume
-               (reference semantics, computed on device), taker_after
-      cancels = dict of [e_cancels] arrays: src (flat r*T + t), volume
-    Events beyond the static buffers are NOT lost — totals lets the host
-    detect the overflow and re-run the frame on the exact path."""
-    fq = outs.fill_qty  # [R, T, K]
-    r, t_len, k = fq.shape
-    mask = (fq > 0).reshape(-1)
-    idx = jnp.cumsum(mask.astype(jnp.int32)) - 1
-    tgt = jnp.where(mask, idx, e_fills)
-
-    def take(arr):
-        flat = arr.reshape(-1)
-        return jnp.zeros((e_fills,), flat.dtype).at[tgt].set(
-            flat, mode="drop"
-        )
-
-    maker_volume = jnp.where(
-        outs.maker_remaining == 0, outs.maker_prefill, outs.maker_remaining
-    )
-    fills = dict(
-        src=take(jnp.arange(r * t_len * k, dtype=jnp.int32)),
-        fill_price=take(outs.fill_price),
-        fill_qty=take(fq),
-        maker_oid=take(outs.maker_oid),
-        maker_uid=take(outs.maker_uid),
-        maker_volume=take(maker_volume),
-        taker_after=take(outs.taker_after),
-    )
-
-    cmask = (outs.cancel_found != 0).reshape(-1)  # [R*T]
-    cidx = jnp.cumsum(cmask.astype(jnp.int32)) - 1
-    ctgt = jnp.where(cmask, cidx, e_cancels)
-
-    def ctake(arr):
-        flat = arr.reshape(-1)
-        return jnp.zeros((e_cancels,), flat.dtype).at[ctgt].set(
-            flat, mode="drop"
-        )
-
-    cancels = dict(
-        src=ctake(jnp.arange(r * t_len, dtype=jnp.int32)),
-        volume=ctake(outs.cancel_volume),
-    )
-    totals = jnp.stack(
-        [
-            jnp.sum(mask.astype(jnp.int32)),
-            jnp.sum(cmask.astype(jnp.int32)),
-            jnp.sum(outs.book_overflow),
-            jnp.max(outs.n_fills),
-        ]
-    )
-    return totals, fills, cancels
+#: Row order of the packed compaction matrices (fetch layout).
+_FILL_FIELDS = (
+    "src", "fill_price", "fill_qty", "maker_oid", "maker_uid",
+    "maker_volume", "taker_after",
+)
+_CANCEL_FIELDS = ("src", "volume")
 
 
 def _decode_compact(eng, meta, shape, fetched) -> dict:
@@ -481,42 +481,120 @@ def _decode_compact(eng, meta, shape, fetched) -> dict:
     return {name: v[order] for name, v in columns.items()}
 
 
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2, 3, 4))
+def compact_accum(config, outs, fills_acc, cancels_acc, totals_acc, g):
+    """Append one grid's compacted events into the FRAME-level buffers.
+
+    Like compact_step_outputs, but events land at the frame's running
+    offsets (the sums of earlier grids' counts in totals_acc) instead of
+    per-grid buffers — the whole frame then resolves with ONE fetch of
+    three arrays. On a tunneled dev link each fetched array pays ~tens of
+    ms of fixed cost, and a Zipf frame's grid TRAIN (dozens of grids)
+    made the fetch COUNT, not the bytes, the end-to-end ceiling: 3*G
+    arrays -> 3. The accumulators are donated, so the train appends in
+    place with no host sync; totals_acc[g] records this grid's TRUE
+    fill/cancel counts (+ overflow flag + max n_fills), which is also
+    how the host later splits the flat buffers back into grids."""
+    e_fills = fills_acc.shape[1]
+    e_cancels = cancels_acc.shape[1]
+    wide = fills_acc.dtype
+    off_f = jnp.sum(totals_acc[:, 0])
+    off_c = jnp.sum(totals_acc[:, 1])
+    fq = outs.fill_qty  # [R, T, K]
+    r, t_len, k = fq.shape
+    mask = (fq > 0).reshape(-1)
+    idx = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    tgt = jnp.where(mask, off_f + idx, e_fills)
+    maker_volume = jnp.where(
+        outs.maker_remaining == 0, outs.maker_prefill, outs.maker_remaining
+    )
+    fill_src = dict(
+        src=jnp.arange(r * t_len * k, dtype=jnp.int32),
+        fill_price=outs.fill_price,
+        fill_qty=fq,
+        maker_oid=outs.maker_oid,
+        maker_uid=outs.maker_uid,
+        maker_volume=maker_volume,
+        taker_after=outs.taker_after,
+    )
+    vals = jnp.stack(
+        [fill_src[f].reshape(-1).astype(wide) for f in _FILL_FIELDS]
+    )
+    fills_acc = fills_acc.at[:, tgt].set(vals, mode="drop")
+
+    cmask = (outs.cancel_found != 0).reshape(-1)  # [R*T]
+    cidx = jnp.cumsum(cmask.astype(jnp.int32)) - 1
+    ctgt = jnp.where(cmask, off_c + cidx, e_cancels)
+    cancel_src = dict(
+        src=jnp.arange(r * t_len, dtype=jnp.int32),
+        volume=outs.cancel_volume,
+    )
+    cvals = jnp.stack(
+        [cancel_src[f].reshape(-1).astype(wide) for f in _CANCEL_FIELDS]
+    )
+    cancels_acc = cancels_acc.at[:, ctgt].set(cvals, mode="drop")
+    totals_acc = totals_acc.at[g].set(
+        jnp.stack(
+            [
+                jnp.sum(mask.astype(jnp.int32)),
+                jnp.sum(cmask.astype(jnp.int32)),
+                jnp.sum(outs.book_overflow).astype(jnp.int32),
+                jnp.max(outs.n_fills).astype(jnp.int32),
+            ]
+        ).astype(jnp.int32)  # x64 promotes int32 sums to int64
+    )
+    return fills_acc, cancels_acc, totals_acc
+
+
 class PendingFrame:
     """A frame whose grids are dispatched (device side in flight) but not
     yet resolved: everything resolve_frame needs, plus the checkpoint that
     makes a tripped budget or failure transactionally recoverable."""
 
-    __slots__ = ("cols", "arrays", "checkpoint", "items")
+    __slots__ = ("cols", "arrays", "checkpoint", "items", "compact",
+                 "n_kept")
 
-    def __init__(self, cols, arrays, checkpoint, items):
+    def __init__(self, cols, arrays, checkpoint, items, compact, n_kept):
         self.cols = cols
         self.arrays = arrays
         self.checkpoint = checkpoint
-        self.items = items  # [(meta, (t_grid, K), compact, n_ops)]
+        self.items = items  # [(meta, (t_grid, K))]
+        self.compact = compact  # (totals_acc, fills_acc, cancels_acc)|None
+        self.n_kept = n_kept
 
 
 def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
     """Dispatch every grid of the frame + its device-side compaction
-    back-to-back (no host sync) and start the async device->host copies.
-    Advances eng.books — a later submit_frame builds on this frame's
-    result, so frames pipeline while preserving sequential semantics.
-    Raises (with rollback) only on host-side errors; device budget trips
-    surface at resolve_frame."""
+    back-to-back (no host sync) and start the async device->host copy of
+    the frame-level event buffers. Advances eng.books — a later
+    submit_frame builds on this frame's result, so frames pipeline while
+    preserving sequential semantics. Raises (with rollback) only on
+    host-side errors; device budget trips surface at resolve_frame."""
     cp = eng._checkpoint()
     try:
         a = _frame_arrays(eng, cols)
         grids = pack_frame_grids(eng, a)
         books = eng.books
         items = []
-        for ops, meta, lane_ids in grids:
+        compact = None
+        n_kept = int(np.count_nonzero(a["keep"]))
+        if grids:
+            e_fills, e_cancels = _compact_sizes(
+                eng, n_kept, a["dels_total"]
+            )
+            wide = jnp.result_type(jnp.int32, eng.config.dtype)
+            fills_acc = jnp.zeros((len(_FILL_FIELDS), e_fills), wide)
+            cancels_acc = jnp.zeros((len(_CANCEL_FIELDS), e_cancels), wide)
+            totals_acc = jnp.zeros(
+                (max(_next_pow2(len(grids)), 8), 4), jnp.int32
+            )
+        for g_i, (ops, meta, lane_ids) in enumerate(grids):
             books, outs = eng._step(books, ops, lane_ids)
             eng.stats.device_calls += 1
             n_rows, t_grid = ops.action.shape
-            n_ops = len(meta["row"])
-            n_dels = int((meta["action"] == ACTION_DEL).sum())
-            e_fills, e_cancels = _compact_sizes(eng, n_ops, n_dels)
-            compact = compact_step_outputs(
-                eng.config, outs, e_fills, e_cancels
+            fills_acc, cancels_acc, totals_acc = compact_accum(
+                eng.config, outs, fills_acc, cancels_acc, totals_acc,
+                np.int32(g_i),
             )
             meta["_n_rows"] = n_rows
             # The record axis K comes from the ARRAY, never from
@@ -527,56 +605,77 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
             # (fuzz-found: seed 9087, cap=4 K=8 mis-decoded fills and
             # would have silently dropped records of >K-fill ops).
             k_rec = int(outs.fill_qty.shape[-1])
-            items.append((meta, (t_grid, k_rec), compact, n_ops))
+            items.append((meta, (t_grid, k_rec)))
         eng.books = books
-        for _, _, compact, _ in items:
-            for leaf in jax.tree.leaves(compact):
+        if grids:
+            compact = (totals_acc, fills_acc, cancels_acc)
+            for leaf in compact:
                 leaf.copy_to_host_async()
-        return PendingFrame(cols, a, cp, items)
+        return PendingFrame(cols, a, cp, items, compact, n_kept)
     except Exception:
         eng._restore(cp)
         raise
 
 
 def resolve_frame(eng: BatchEngine, pend: PendingFrame):
-    """Fetch + decode a submitted frame. Raises _NeedExact when a device
-    budget tripped — the CALLER owns the recovery (rewind to
-    pend.checkpoint, exact-run, resubmit anything submitted after); the
-    single-frame wrapper apply_frame_fast and the pipelined executor
+    """Fetch + decode a submitted frame (ONE device->host fetch of the
+    frame-level event buffers). Raises _NeedExact when a device budget
+    tripped — the CALLER owns the recovery (rewind to pend.checkpoint,
+    exact-run, resubmit anything submitted after); the single-frame
+    wrapper apply_frame_fast and the pipelined executor
     (engine.pipeline.FramePipeline) both do."""
-    batches = []
+    if pend.compact is None:
+        return _assemble(eng, pend.arrays, [])
     global FETCH_SECONDS
-    for meta, shape, compact, n_ops in pend.items:
-        t0 = time.perf_counter()
-        fetched = jax.device_get(compact)
-        FETCH_SECONDS += time.perf_counter() - t0
-        totals = fetched[0]
-        # A fills-buffer overflow ratchets the grow-only floor BEFORE the
-        # exact fallback, so the next frame's buffer fits (one slow frame
-        # per ratchet step, not a recurring tax). totals[0] is the TRUE
-        # fill count (the compaction drops writes past the buffer but
-        # sums the full mask), so one step reaches the right size.
-        n_fills_seen = int(totals[0])
-        tripped = False
-        if n_fills_seen > len(fetched[1]["src"]):
-            cls = eng._buf_class(n_ops)
-            eng._fills_buf_floor[cls] = max(
-                eng._fills_buf_floor.get(cls, 0), _next_pow2(n_fills_seen)
-            )
-            tripped = True
-        if (
-            tripped
-            or int(totals[2]) > 0  # book overflow: state is wrong
-            # Records truncated: an op produced more fills than the K the
-            # record arrays were emitted with (shape[1] — the ARRAY axis,
-            # which cap may clamp below config.max_fills).
-            or int(totals[3]) > shape[1]
-            # Unreachable by construction (cancels <= the grid's DEL
-            # count, which sizes the buffer) — defensive only.
-            or int(totals[1]) > len(fetched[2]["src"])
-        ):
-            raise _NeedExact()
-        batches.append(_decode_compact(eng, meta, shape, fetched))
+    t0 = time.perf_counter()
+    totals, fills_mat, cancels_mat = jax.device_get(pend.compact)
+    FETCH_SECONDS += time.perf_counter() - t0
+    g = len(pend.items)
+    nf_g = totals[:g, 0].astype(np.int64)
+    nc_g = totals[:g, 1].astype(np.int64)
+    total_f = int(nf_g.sum())
+    total_c = int(nc_g.sum())
+    # A fills-buffer overflow ratchets the grow-only floor (keyed by the
+    # FRAME's kept-op class) BEFORE the exact fallback, so the next frame
+    # fits — one slow frame per ratchet step, not a recurring tax. The
+    # totals are TRUE counts (appends past the buffer drop but the mask
+    # sums fully), so one step reaches the right size.
+    tripped = False
+    if total_f > fills_mat.shape[1]:
+        cls = eng._buf_class(pend.n_kept)
+        eng._fills_buf_floor[cls] = max(
+            eng._fills_buf_floor.get(cls, 0), _next_pow2(total_f)
+        )
+        tripped = True
+    if (
+        tripped
+        or int(totals[:g, 2].sum()) > 0  # book overflow: state is wrong
+        # Records truncated: an op produced more fills than the K its
+        # grid's record arrays were emitted with.
+        or any(
+            int(totals[i, 3]) > shape[1]
+            for i, (_, shape) in enumerate(pend.items)
+        )
+        # Unreachable by construction (cancels <= the frame's DEL count,
+        # which sizes the buffer) — defensive only.
+        or total_c > cancels_mat.shape[1]
+    ):
+        raise _NeedExact()
+    off_f = np.concatenate(([0], np.cumsum(nf_g)))
+    off_c = np.concatenate(([0], np.cumsum(nc_g)))
+    batches = []
+    for i, (meta, shape) in enumerate(pend.items):
+        fills = {
+            f: fills_mat[j, off_f[i] : off_f[i + 1]]
+            for j, f in enumerate(_FILL_FIELDS)
+        }
+        cancels = {
+            f: cancels_mat[j, off_c[i] : off_c[i + 1]]
+            for j, f in enumerate(_CANCEL_FIELDS)
+        }
+        batches.append(
+            _decode_compact(eng, meta, shape, (totals[i], fills, cancels))
+        )
     return _assemble(eng, pend.arrays, batches)
 
 
@@ -593,6 +692,7 @@ def apply_frame_fast(eng: BatchEngine, cols: dict):
     try:
         return resolve_frame(eng, pend)
     except _NeedExact:
+        eng.stats.frame_fallbacks += 1
         eng._restore(pend.checkpoint)
         try:
             return apply_frame(eng, cols)
@@ -618,20 +718,20 @@ def _compact_sizes(eng, n_ops: int, n_dels: int) -> tuple[int, int]:
                 bound for its cancel events; a pure-ADD stream fetches a
                 64-slot stub instead of an n_ops-sized buffer of zeros).
 
-    Both sizes are grow-only ratchets KEYED BY the grid's pow2 op-count
-    class (BatchEngine._fills_buf_floor): within a class, a grid that
-    needs a larger buffer raises the floor so later grids reuse one
-    compiled shape instead of oscillating (data-dependent sizes would
-    recompile whenever a DEL count straddled a pow2 boundary); across
-    classes, floors stay independent so a frame mixing one huge full
-    grid with a train of small dense grids (Zipf flows) does not fetch
-    the big grid's buffer for every small one. A grid whose FILL count
-    overflows its buffer transactionally re-runs on the exact path
-    (resolve_frame) AND raises its class's floor, so that costs one slow
-    frame per ratchet step, not a recurring tax; cancel events can never
-    overflow (cancels <= n_dels by construction, step.py cancel_found).
-    Deployments that know their flow pre-warm the floors
-    (BatchEngine.prewarm_geometry)."""
+    Called once per FRAME (n_ops = the frame's kept ops; the whole
+    frame's grids append into one buffer pair via compact_accum). Sizes
+    are grow-only ratchets KEYED BY the pow2 op-count class
+    (BatchEngine._fills_buf_floor): within a class, a frame that needs a
+    larger buffer raises the floor so later frames reuse one compiled
+    shape instead of oscillating (data-dependent sizes would recompile
+    whenever a DEL count straddled a pow2 boundary); across classes,
+    floors stay independent so small frames never fetch a big frame's
+    buffer. A frame whose FILL count overflows its buffer transactionally
+    re-runs on the exact path (resolve_frame) AND raises its class's
+    floor, so that costs one slow frame per ratchet step, not a recurring
+    tax; cancel events can never overflow (cancels <= n_dels by
+    construction, step.py cancel_found). Deployments that know their flow
+    pre-warm the floors (BatchEngine.prewarm_geometry)."""
     cls = eng._buf_class(n_ops)
     fills = max(cls, eng._fills_buf_floor.get(cls, 0))
     cancels = max(

@@ -90,11 +90,11 @@ def load():
     ]
     lib.go_pack_grid.restype = _i64
     lib.go_pack_grid.argtypes = (
-        [_i64, _p_i64, _p_i64, _p_i64, _i64, _i64, _i64]  # n..n_rows
+        [_i64, _p_i64]  # n_sub, idx
+        + [_p_i64, _p_i64, _p_i64, _i64, _i64, _i64]  # row_of..n_rows
         + [_p_i64] * 8  # action..bases
         + [_i64, _i64]  # market_val, add_val
-        + [ctypes.c_void_p] * 3  # g_action, g_side, g_market (i32)
-        + [ctypes.c_void_p] * 4 + [_i64]  # value grids + itemsize
+        + [ctypes.c_void_p, ctypes.c_void_p, _i64, _i64]  # cols/flat/stride/itemsize
         + [_p_i64] * 11  # meta outputs
     )
     lib.go_decode_compact.restype = _i64
@@ -181,45 +181,45 @@ _META_NAMES = (
 
 
 def pack_grid(
-    a: dict, rows: np.ndarray, t_off: int, t_grid: int, n_rows: int,
-    val_dtype, market_val: int, add_val: int,
-) -> tuple[dict, dict]:
-    """One grid's scatter + meta extraction in a single native pass (the
-    C++ form of frames.pack_frame_grids' inner loop). `a` is the
-    _frame_arrays dict; `rows` the per-op grid row. Returns (grid dict of
-    [n_rows, t_grid] arrays, meta dict of [m] int64 columns)."""
-    from .book import GRID_I32_FIELDS, DeviceOp
-
+    a: dict, idx: np.ndarray, row_of: np.ndarray, t_off: int, t_grid: int,
+    n_rows: int, m_pad: int, val_dtype, market_val: int, add_val: int,
+) -> tuple[np.ndarray, np.ndarray, dict]:
+    """One grid's column pack + meta extraction in a single native pass
+    (the C++ form of frames.pack_frame_grids' inner loop). `a` is the
+    _frame_arrays dict; `idx` the candidate op indices still alive at
+    this grid's time offset (a train's later grids pass shrinking
+    subsets); `row_of` the [n_slots] lane -> grid-row map (identity for
+    full grids); `m_pad` the pow2-padded column count (padding columns
+    carry the out-of-grid sentinel flat index and scatter-drop on
+    device). Returns (cols [7, m_pad] in DeviceOp field order, flat
+    [m_pad] int32 grid positions, meta dict of [m] int64 columns;
+    meta['arrival'] carries original frame indices)."""
     lib = load()
-    n = a["n"]
     i64 = lambda x: np.ascontiguousarray(x, np.int64)
-    rows = i64(rows)
+    idx = i64(idx)
+    row_of = i64(row_of)
     t = i64(a["t"])
-    m = int(np.count_nonzero((t >= t_off) & (t < t_off + t_grid)))
+    t_sub = t[idx]
+    m = int(np.count_nonzero((t_sub >= t_off) & (t_sub < t_off + t_grid)))
+    assert m <= m_pad, (m, m_pad)
     val_dtype = np.dtype(val_dtype)
-    grid = {
-        name: np.zeros(
-            (n_rows, t_grid),
-            np.int32 if name in GRID_I32_FIELDS else val_dtype,
-        )
-        for name in DeviceOp._fields
-    }
+    cols = np.empty((7, m_pad), val_dtype)
+    flat = np.full(m_pad, n_rows * t_grid, np.int32)  # sentinel: drop
     meta = {name: np.empty(m, np.int64) for name in _META_NAMES}
     p = lambda arr: arr.ctypes.data_as(_p_i64)
     v = lambda arr: arr.ctypes.data_as(ctypes.c_void_p)
     got = lib.go_pack_grid(
-        n, p(rows), p(i64(a["lanes"])), p(t), t_off, t_grid, n_rows,
+        len(idx), p(idx), p(row_of), p(i64(a["lanes"])), p(t), t_off,
+        t_grid, n_rows,
         p(i64(a["action"])), p(i64(a["side"])), p(i64(a["kind"])),
         p(i64(a["price"])), p(i64(a["volume"])), p(i64(a["oid_ids"])),
         p(i64(a["uid_ids"])), p(i64(a["bases"])), market_val, add_val,
-        v(grid["action"]), v(grid["side"]), v(grid["is_market"]),
-        v(grid["price"]), v(grid["volume"]), v(grid["oid"]), v(grid["uid"]),
-        val_dtype.itemsize,
+        v(cols), v(flat), m_pad, val_dtype.itemsize,
         *(p(meta[name]) for name in _META_NAMES),
     )
     if got != m:
         raise RuntimeError(f"native grid pack failed (packed {got} != {m})")
-    return grid, meta
+    return cols, flat, meta
 
 
 def occurrences(lanes: np.ndarray, keep, n_lanes: int) -> np.ndarray:

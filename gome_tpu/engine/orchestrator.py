@@ -44,13 +44,17 @@ class MatchEngine:
         max_t: int = 32,
         auto_grow: bool = True,
         kernel: str = "scan",
+        **batch_kw,
     ):
+        """batch_kw passes through to BatchEngine (mesh, dense,
+        dense_t_max, max_slots, max_cap, pallas_interpret)."""
         self.batch = BatchEngine(
             config or BookConfig(),
             n_slots,
             max_t=max_t,
             auto_grow=auto_grow,
             kernel=kernel,
+            **batch_kw,
         )
         # The marker store shared with the gateway. In-process by default
         # (C++-backed when the toolchain allows — prepool.NativePrePool);

@@ -98,6 +98,7 @@ class FramePipeline:
         try:
             return (token, frames.resolve_frame(eng, pend))
         except frames._NeedExact:
+            eng.stats.frame_fallbacks += 1
             # Budget tripped: rewind THROUGH every later in-flight frame
             # (they were submitted on top of the bad state), replay this
             # frame exactly, then resubmit the later ones.

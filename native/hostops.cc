@@ -682,51 +682,65 @@ int64_t go_decode_compact(
 }
 
 // Fused grid pack: one linear pass selects the frame ops landing in this
-// grid's time window, scatters all 7 op fields into the (pre-zeroed) grid
-// arrays, and extracts the packed-op meta columns the event decoder needs
-// — replacing ~20 separate numpy mask/scatter passes in
-// frames.pack_frame_grids. Value grids are int32 or int64 (val_itemsize).
-// Meta outputs are int64 [m] where m = |{i : t_off <= t[i] < t_off+t_grid}|
-// (the caller sizes them with one count pass). Returns the number packed
-// (must equal m) or -1 on a row/t out of grid bounds (corrupt input).
+// grid's time window and emits (a) the DEVICE-UPLOAD columns — a [7, m]
+// field matrix plus the [m] flat grid index each op scatters to ON
+// DEVICE — and (b) the packed-op meta columns the event decoder needs,
+// replacing ~20 separate numpy mask/scatter passes in
+// frames.pack_frame_grids. Emitting columns instead of padded [R, T]
+// grids keeps the host->device transfer O(ops): a Zipf frame's deep
+// tail grids are ~1% occupied, and uploading their padding cost more
+// than the matching (the device rebuilds the padded grid with one
+// scatter — frames._scatter_grid).
+//
+// The pass walks `idx` (n_sub candidate op indices into the frame-global
+// field arrays): a frame that splits into a train of grids hands each
+// grid only the ops still alive at its time offset, so a G-grid train
+// costs O(sum of survivors), not O(G * frame). cols is [7, m] in
+// _GRID_FIELDS order (action, side, is_market, price, volume, oid, uid),
+// int32 or int64 (val_itemsize). Meta outputs are int64 [m] where
+// m = |{j : t_off <= t[idx[j]] < t_off+t_grid}| (the caller sizes them
+// with one count pass); meta arrival carries the ORIGINAL frame index
+// idx[j]. Returns the number packed (must equal m) or -1 on a row/t out
+// of grid bounds (corrupt input).
 int64_t go_pack_grid(
-    int64_t n, const int64_t* rows, const int64_t* lanes, const int64_t* t,
+    int64_t n_sub, const int64_t* idx, const int64_t* row_of,
+    const int64_t* lanes, const int64_t* t,
     int64_t t_off, int64_t t_grid, int64_t n_rows,
     const int64_t* action, const int64_t* side, const int64_t* kind,
     const int64_t* price, const int64_t* volume, const int64_t* oid_ids,
     const int64_t* uid_ids, const int64_t* bases, int64_t market_val,
     int64_t add_val,
-    int32_t* g_action, int32_t* g_side, int32_t* g_market, void* g_price,
-    void* g_volume, void* g_oid, void* g_uid, int64_t val_itemsize,
+    void* cols, void* flat_idx, int64_t stride, int64_t val_itemsize,
     int64_t* m_lane, int64_t* m_row, int64_t* m_t, int64_t* m_arrival,
     int64_t* m_action, int64_t* m_side, int64_t* m_market, int64_t* m_price,
     int64_t* m_base, int64_t* m_oid, int64_t* m_uid) {
+  // `stride` = the cols matrix's padded column count (a pow2 class, so
+  // upload shapes stay compile-stable); rows are written at [f*stride+j].
   bool wide = val_itemsize == 8;
+  int64_t m = stride;
   int64_t j = 0;
-  for (int64_t i = 0; i < n; ++i) {
+  for (int64_t s = 0; s < n_sub; ++s) {
+    int64_t i = idx[s];
     int64_t ti = t[i];
     if (ti < t_off || ti >= t_off + t_grid) continue;
     int64_t tt = ti - t_off;
-    int64_t r = rows[i];
+    int64_t r = row_of[lanes[i]];  // lane -> grid row (identity when full)
     if (r < 0 || r >= n_rows) return -1;
     int64_t flat = r * t_grid + tt;
     int64_t a = action[i];
     bool is_mkt = kind[i] == market_val && a == add_val;
     int64_t p_dev = is_mkt ? 0 : price[i] - bases[i];
-    g_action[flat] = static_cast<int32_t>(a);
-    g_side[flat] = static_cast<int32_t>(side[i]);
-    g_market[flat] = is_mkt ? 1 : 0;
+    int64_t vals[7] = {a,         side[i],     is_mkt ? 1 : 0, p_dev,
+                       volume[i], oid_ids[i],  uid_ids[i]};
     if (wide) {
-      static_cast<int64_t*>(g_price)[flat] = p_dev;
-      static_cast<int64_t*>(g_volume)[flat] = volume[i];
-      static_cast<int64_t*>(g_oid)[flat] = oid_ids[i];
-      static_cast<int64_t*>(g_uid)[flat] = uid_ids[i];
+      auto* c = static_cast<int64_t*>(cols);
+      for (int f = 0; f < 7; ++f) c[f * m + j] = vals[f];
     } else {
-      static_cast<int32_t*>(g_price)[flat] = static_cast<int32_t>(p_dev);
-      static_cast<int32_t*>(g_volume)[flat] = static_cast<int32_t>(volume[i]);
-      static_cast<int32_t*>(g_oid)[flat] = static_cast<int32_t>(oid_ids[i]);
-      static_cast<int32_t*>(g_uid)[flat] = static_cast<int32_t>(uid_ids[i]);
+      auto* c = static_cast<int32_t*>(cols);
+      for (int f = 0; f < 7; ++f)
+        c[f * m + j] = static_cast<int32_t>(vals[f]);
     }
+    static_cast<int32_t*>(flat_idx)[j] = static_cast<int32_t>(flat);
     m_lane[j] = lanes[i];
     m_row[j] = r;
     m_t[j] = tt;

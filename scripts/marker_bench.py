@@ -1,0 +1,122 @@
+"""Standalone marker-store bench: bounds the RESP server's throughput on
+the sharded topology's admission path (VERDICT r3 weak #7 — the
+thread-per-connection Python server sits on every shard's admission path;
+nothing previously bounded it at production rates).
+
+Measures, against a fresh respserver process over a real socket:
+  * mark_frame-style marking: grouped variadic HSETs, one pipelined round
+    trip per frame (the gateway side);
+  * admission-style consumption: one pipelined round trip of per-key
+    HDELs per frame (the consumer side).
+
+Prints one JSON line per direction with orders/sec.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from gome_tpu.engine.prepool import RespPrePool
+from gome_tpu.persist.resp import RespClient
+
+N = int(os.environ.get("MARKER_ORDERS", 1 << 20))
+FRAME = int(os.environ.get("MARKER_FRAME", 1 << 15))
+N_SYMBOLS = int(os.environ.get("MARKER_SYMBOLS", 1024))
+
+
+def main():
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "gome_tpu.persist.respserver", "--port", "0"],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        ready = srv.stdout.readline().split()
+        assert ready and ready[0] == "READY", ready
+        port = int(ready[1])
+        pool = RespPrePool(RespClient(port=port))
+
+        rng = np.random.default_rng(5)
+        symbols = [f"sym{i}" for i in range(N_SYMBOLS)]
+        frames = []
+        oid0 = 0
+        for start in range(0, N, FRAME):
+            n = min(FRAME, N - start)
+            frames.append(
+                dict(
+                    n=n,
+                    action=np.ones(n, np.uint8),
+                    symbols=symbols,
+                    symbol_idx=rng.integers(0, N_SYMBOLS, n).astype(
+                        np.uint32
+                    ),
+                    uuids=["u"],
+                    uuid_idx=np.zeros(n, np.uint32),
+                    oids=np.char.add(
+                        "o", np.arange(oid0, oid0 + n).astype("U12")
+                    ).astype("S"),
+                )
+            )
+            oid0 += n
+
+        # Warmup (connection, server JIT-ish costs).
+        pool.mark_frame(frames[0])
+        t0 = time.perf_counter()
+        for cols in frames[1:]:
+            pool.mark_frame(cols)
+        mark_s = time.perf_counter() - t0
+        n_marked = sum(int(c["n"]) for c in frames[1:])
+
+        def consume(cols):
+            keys = [
+                (symbols[k], "u", o.decode())
+                for k, o in zip(
+                    cols["symbol_idx"].tolist(), cols["oids"].tolist()
+                )
+            ]
+            return pool.consume_batch(keys)
+
+        consume(frames[0])
+        t0 = time.perf_counter()
+        hits = 0
+        for cols in frames[1:]:
+            hits += sum(consume(cols))
+        del_s = time.perf_counter() - t0
+        assert hits == n_marked, (hits, n_marked)
+
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        f"marker-server mark_frame (grouped variadic HSET, "
+                        f"{FRAME}-order frames, real RESP socket)"
+                    ),
+                    "value": round(n_marked / mark_s),
+                    "unit": "orders/sec",
+                }
+            )
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        f"marker-server consume (pipelined HDEL, "
+                        f"{FRAME}-order frames, real RESP socket)"
+                    ),
+                    "value": round(n_marked / del_s),
+                    "unit": "orders/sec",
+                }
+            )
+        )
+    finally:
+        srv.terminate()
+        srv.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    main()

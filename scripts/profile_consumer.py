@@ -46,6 +46,7 @@ PIPE = int(os.environ.get("SVC_PIPELINE", 2))
 engine = MatchEngine(
     config=BookConfig(cap=CAP, max_fills=16, dtype=jnp.int32),
     n_slots=S, max_t=32, kernel="pallas",
+    dense_t_max=int(os.environ.get("SVC_DENSE_T", 8192)),
 )
 bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
 consumer = OrderConsumer(

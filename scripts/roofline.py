@@ -1,0 +1,109 @@
+"""Device-kernel roofline probe (ARCHITECTURE.md's roofline section).
+
+Times the compiled Pallas match kernel at the headline shape while
+sweeping the knobs that distinguish the candidate ceilings:
+
+  * cap sweep    — per-step work is O(cap) vector ops over [block_s, cap]
+                   tiles; if throughput scales ~1/cap the kernel is
+                   compute/dependency-bound, not launch-bound;
+  * block_t sweep — deeper time blocks amortize grid/launch overhead; a
+                   plateau means launches are not the ceiling;
+  * block_s sweep — more lanes per block raises SIMD width utilization.
+
+Prints one JSON line per point: {cap, block_t, block_s, orders_per_sec,
+cycles_per_block_step} (cycles = block_s * f / throughput, f = 940 MHz
+for v5e — the serial per-step critical path the dependency chain pays).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _enable_jax_cache, build_grids
+
+_enable_jax_cache()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gome_tpu.engine import BookConfig, init_books
+from gome_tpu.engine.book import DeviceOp
+from gome_tpu.ops import pallas_batch_step
+
+F_HZ = float(os.environ.get("ROOFLINE_CLOCK_HZ", 940e6))  # v5e TensorCore
+S = int(os.environ.get("ROOFLINE_SYMBOLS", 10240))
+T = int(os.environ.get("ROOFLINE_T", 16))
+G = int(os.environ.get("ROOFLINE_GRIDS", 24))
+REPEATS = int(os.environ.get("ROOFLINE_REPEATS", 3))
+
+
+def run_point(cap, block_s, block_t):
+    config = BookConfig(cap=cap, max_fills=16, dtype=jnp.int32)
+    stepper = jax.jit(
+        lambda books, ops: pallas_batch_step(
+            config, books, ops, block_s=block_s, block_t=block_t
+        ),
+        donate_argnums=(0,),
+    )
+    fold = jax.jit(lambda o: jnp.sum(o.n_fills))
+    raw = build_grids(S, T, G + 2, dtype=np.int32)
+    for d in raw:
+        d["volume"] = (d["volume"] // 1_000_000).astype(np.int32)
+    grids = [jax.device_put(DeviceOp(**d)) for d in raw]
+    jax.block_until_ready(grids)
+    books = init_books(config, S)
+    books, outs = stepper(books, grids[0])
+    acc = fold(outs)
+    books, outs = stepper(books, grids[1])
+    int(acc + fold(outs))
+    books0 = jax.tree.map(jnp.copy, books)
+    int(jnp.sum(books0.count))
+    best = float("inf")
+    for _ in range(REPEATS):
+        books = jax.tree.map(jnp.copy, books0)
+        int(jnp.sum(books.count))
+        acc = None
+        t0 = time.perf_counter()
+        for g in grids[2:]:
+            books, outs = stepper(books, g)
+            f = fold(outs)
+            acc = f if acc is None else acc + f
+        int(acc)  # completion barrier
+        best = min(best, time.perf_counter() - t0)
+    rate = S * T * G / best
+    # Cycles each serial time step costs one lane block: rate = (S/B_s
+    # blocks advance in parallel is FALSE — blocks are grid-parallel in
+    # sequence on one core) => time = (S/block_s) * T * C / f;
+    # C = f * block_s / rate.
+    cycles = F_HZ * block_s / rate
+    print(
+        json.dumps(
+            dict(
+                cap=cap,
+                block_s=block_s,
+                block_t=block_t,
+                orders_per_sec=round(rate),
+                cycles_per_block_step=round(cycles, 1),
+            )
+        ),
+        flush=True,
+    )
+    return rate
+
+
+def main():
+    # Headline point + cap sweep at fixed blocking.
+    for cap in (64, 128, 256, 512):
+        run_point(cap, 128, min(T, 16))
+    # block_t sweep at headline cap.
+    for bt in (1, 2, 4, 8, 16):
+        if T % bt == 0:
+            run_point(256, 128, bt)
+
+
+if __name__ == "__main__":
+    main()
