@@ -810,13 +810,19 @@ def grpc_main():
         n_slots=S,
         max_t=32,
         kernel="pallas",
+        # Full grids only: the batcher's deadline flushes emit arbitrary
+        # partial-frame sizes, and letting each pick its own dense-grid
+        # geometry compiles a fresh kernel per size class — on a tunneled
+        # dev TPU that is a 30s stall per shape. At 1024 uniform lanes the
+        # full [S, max_t] grid is one compiled family and near-optimal.
+        dense=False,
     )
     bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
     consumer = OrderConsumer(
         engine, bus, batch_n=64, batch_wait_s=0.001, match_wire="frame",
         pipeline_depth=PIPE,
     )
-    batcher = FrameBatcher(bus.order_queue, max_n=BATCH, max_wait_s=0.005)
+    batcher = FrameBatcher(bus.order_queue, max_n=BATCH, max_wait_s=0.05)
     gateway = OrderGateway(
         bus, accuracy=8, mark=engine.mark, batcher=batcher
     )
