@@ -256,19 +256,34 @@ def pack_frame_grids(eng: BatchEngine, a: dict) -> list[tuple]:
                 )
                 // 2,
             )
-            bucket_t = _next_pow2 if first else _next_pow4
-            t_grid = min(
-                max(
-                    bucket_t(int(t_sub.max()) - t_off + 1),
-                    eng._dense_t_floor if first else 8,
-                ),
-                max(eng.dense_t_max, eng.max_t),
-                t_mem,
-            )
+            cap_t = max(8, min(max(eng.dense_t_max, eng.max_t), t_mem))
+            need = int(t_sub.max()) - t_off + 1
             if first:
+                t_grid = min(
+                    max(_next_pow2(need), eng._dense_t_floor), cap_t
+                )
                 # Grow-only; a mem-clamped wide grid leaves the floor for
                 # future narrower (deeper-capable) first grids.
                 eng._dense_t_floor = max(eng._dense_t_floor, t_grid)
+            else:
+                # Train tails snap to FOUR fixed depth classes (shallow /
+                # 8x-shallow / quarter-ceiling / ceiling): every distinct
+                # (rows, depth) is a compiled shape, and a hot lane's
+                # per-frame depth noise would otherwise keep minting new
+                # buckets for the life of the process (~1s of host
+                # re-trace each). The 8x-shallow class plugs the geometric
+                # hole between max_t and cap_t//4 (padding stays <=8x);
+                # NOP-padded steps on an 8-row tail grid are far cheaper
+                # than re-traces.
+                cands = sorted({
+                    min(max(8, eng.max_t), cap_t),
+                    min(max(8, 8 * eng.max_t), cap_t),
+                    min(max(8, cap_t // 4), cap_t),
+                    cap_t,
+                })
+                t_grid = next(
+                    (c for c in cands if c >= min(need, cap_t)), cap_t
+                )
         else:
             # Full grid: row == lane (identity map).
             row_of = np.arange(eng.n_slots, dtype=np.int64)
