@@ -167,7 +167,7 @@ def _next_pow2(n):
 FIELDS = ("action", "side", "is_market", "price", "volume", "oid", "uid")
 
 
-def pack_dense_rounds(grids, t_dense, s_total):
+def pack_dense_rounds(grids, t_dense, s_total, cap=None, depth_bound=None):
     """Convert NOP-padded [S, T] grids into dense rounds over LIVE lanes
     (the host-side packing the engine's dense path does —
     gome_tpu.engine.batch.dense_batch_step): per lane, concatenate its live
@@ -176,8 +176,24 @@ def pack_dense_rounds(grids, t_dense, s_total):
     time depth bucket to powers of two (bounded compile shapes); padding
     rows carry the out-of-range sentinel lane id = s_total.
 
-    Returns a list of (lane_ids[R], ops dict of [R, T_d]) numpy rounds.
+    cap: the storage cap — when given, each round also gets a CAP CLASS
+    (engine.batch._cap_ladder; VERDICT r4 #2): the smallest class covering
+    every round lane's depth bound. A book can never hold more resting
+    orders than the ops ever sent to it, so bounding by per-lane op totals
+    is provably overflow-free for the tail of a Zipf flow while hot-lane
+    rounds keep the full cap — the device stops paying one hot lane's
+    depth on 10K shallow rows. depth_bound ([s_total] per-lane totals)
+    lets the caller count ops across the WHOLE run (warmup + timed): a
+    chain replaying from post-warmup books carries the warmup's resting
+    depth, which this packer's own timeline cannot see. Defaults to this
+    pack's totals. The engine-side guard (batch._guard_capped) still folds
+    any violation into the overflow count the bench refuses to hide.
+
+    Returns (rounds, caps): rounds = [(lane_ids[R]|None, ops dict of
+    [R, T_d])], caps aligned per round (cap... repeated when cap=None).
     """
+    from gome_tpu.engine.batch import _cap_ladder
+
     streams: dict[int, list] = {}
     for d in grids:
         live = d["action"] != 0
@@ -190,8 +206,25 @@ def pack_dense_rounds(grids, t_dense, s_total):
         lane: {f: np.concatenate([c[f] for c in chunks]) for f in FIELDS}
         for lane, chunks in streams.items()
     }
+    total_len = {lane: len(m["action"]) for lane, m in merged.items()}
+    ladder = _cap_ladder(cap) if cap else None
+    use_classes = (
+        ladder is not None
+        and len(ladder) > 1
+        and os.environ.get("BENCH_CAP_CLASSES", "1") != "0"
+    )
     offsets = {lane: 0 for lane in merged}
     rounds = []
+    caps = []
+
+    def round_cap(lanes):
+        if not use_classes:
+            return cap
+        if depth_bound is not None:
+            bound = max(int(depth_bound[lane]) for lane in lanes)
+        else:
+            bound = max(total_len[lane] for lane in lanes)
+        return next((c for c in ladder if c >= bound), ladder[-1])
 
     def emit(lanes, depth):
         # A round touching most lanes goes out as a FULL grid (lane_ids
@@ -217,6 +250,7 @@ def pack_dense_rounds(grids, t_dense, s_total):
                 offsets[lane] += n
                 if offsets[lane] >= len(merged[lane]["action"]):
                     del merged[lane], offsets[lane]
+            caps.append(round_cap(lanes))
             rounds.append((None, ops))
             return
         # Min 8 rows: the Pallas kernel's sublane-alignment floor; sentinel
@@ -241,6 +275,7 @@ def pack_dense_rounds(grids, t_dense, s_total):
             offsets[lane] += n
             if offsets[lane] >= len(merged[lane]["action"]):
                 del merged[lane], offsets[lane]
+        caps.append(round_cap(lanes))
         rounds.append((lane_ids, ops))
 
     while merged:
@@ -265,7 +300,7 @@ def pack_dense_rounds(grids, t_dense, s_total):
             block = min(max(8, _next_pow2(len(deep))), 128)
             t_vmem = (64 * 128) // block  # ~6MB of [T, K, block] records
             emit(deep, min(t_dense, t_vmem, _next_pow2(max_deep)))
-    return rounds
+    return rounds, caps
 
 
 def _svc_columns(rng, n, n_symbols, oid0):
@@ -474,8 +509,8 @@ def _svc_warmup(engine, consumer, bus, make_frame, symbols):
         n_warm += 1
     g = engine.batch.geometry_floors()
     engine.batch.prewarm_geometry(
-        rows_floor=2 * g["rows_floor"],
-        t_floor=2 * g["t_floor"],
+        rows_floor={c: 2 * v for c, v in g["rows_floor"].items()},
+        t_floor={c: 2 * v for c, v in g["t_floor"].items()},
         cancels_buf={b: 2 * v for b, v in g["cancels_buf"].items()},
         # fills_buf is dominated by pow2(grid n_ops) within each class —
         # no margin needed.
@@ -1345,45 +1380,61 @@ def main():
         # Global depth ceiling; the packer additionally scales each round's
         # depth to the kernel's VMEM budget for its block size.
         t_dense = int(os.environ.get("BENCH_DENSE_T", 1024))
-        warm_rounds = pack_dense_rounds(raw[:2], t_dense, S)
-        timed_rounds = pack_dense_rounds(raw[2:], t_dense, S)
+        # Cap-class depth bound over warmup AND timed ops: the timed chain
+        # replays from post-warmup books, so a lane's resting depth is
+        # bounded by its op total across both phases, not the timed phase
+        # alone.
+        full_bound = sum((d["action"] != 0).sum(axis=1) for d in raw)
+        warm_rounds, warm_caps = pack_dense_rounds(
+            raw[:2], t_dense, S, CAP, depth_bound=full_bound
+        )
+        timed_rounds, timed_caps = pack_dense_rounds(
+            raw[2:], t_dense, S, CAP, depth_bound=full_bound
+        )
         use_kernel = KERNEL == "pallas" and pallas_available(config.dtype)
 
-        def chain_fn(rounds):
+        def chain_fn(rounds, round_caps):
             """One jitted program running a whole round chain: per-dispatch
             cost on a tunneled TPU is milliseconds, so the entire timeline
             must be ONE device dispatch — the unrolled trace chains every
             round's gather -> kernel -> scatter (or full-grid step)
-            back-to-back on device."""
-            from gome_tpu.ops import pallas_batch_step
+            back-to-back on device. Each round runs at ITS cap class (the
+            dense steps slice the shared storage; engine.batch)."""
+            import dataclasses
 
+            from gome_tpu.engine.batch import full_kernel_step
+
+            cfgs = [
+                config if c == CAP else dataclasses.replace(config, cap=c)
+                for c in round_caps
+            ]
             blocks = [
-                default_block_s(S if ids is None else len(ids), CAP)
+                default_block_s(S if ids is None else len(ids), cfg.cap)
                 if use_kernel
                 else None
-                for ids, _ in rounds
+                for (ids, _), cfg in zip(rounds, cfgs)
             ]
 
             def chain(books, rounds):
                 acc = None
-                for (ids, ops), bs in zip(rounds, blocks):
+                for (ids, ops), bs, cfg in zip(rounds, blocks, cfgs):
                     if ids is None:  # full-grid round (no gather/scatter)
                         if bs is not None:
-                            books, outs = pallas_batch_step(
-                                config, books, DeviceOp(**ops), block_s=bs
+                            books, outs = full_kernel_step(
+                                cfg, books, DeviceOp(**ops), bs
                             )
                         else:
                             books, outs = batch_step(
-                                config, books, DeviceOp(**ops)
+                                cfg, books, DeviceOp(**ops)
                             )
                     elif bs is not None:
                         books, outs = dense_kernel_step(
-                            config, books, jnp.asarray(ids),
+                            cfg, books, jnp.asarray(ids),
                             DeviceOp(**ops), bs,
                         )
                     else:
                         books, outs = dense_batch_step(
-                            config, books, jnp.asarray(ids), DeviceOp(**ops)
+                            cfg, books, jnp.asarray(ids), DeviceOp(**ops)
                         )
                     f = jnp.stack(
                         [jnp.sum(outs.n_fills), jnp.sum(outs.book_overflow)]
@@ -1391,10 +1442,15 @@ def main():
                     acc = f if acc is None else acc + f
                 return books, acc
 
-            return jax.jit(chain, donate_argnums=(0,))
+            # NOT donated: every rep replays the identical timeline from
+            # the same post-warmup books0, so the input stack must survive
+            # the call. XLA inserts exactly one protective copy inside the
+            # compiled chain — far cheaper than the 7 per-leaf host
+            # dispatches an eager reset costs over a tunneled link.
+            return jax.jit(chain)
 
-        warm_chain = chain_fn(warm_rounds)
-        timed_chain = chain_fn(timed_rounds)
+        warm_chain = chain_fn(warm_rounds, warm_caps)
+        timed_chain = chain_fn(timed_rounds, timed_caps)
         stage = os.environ.get("BENCH_STAGED", "1") != "0"
         if stage:
             warm_rounds = jax.device_put(warm_rounds)
@@ -1402,19 +1458,22 @@ def main():
             jax.block_until_ready(timed_rounds)
 
         books = init_books(config, S)
-        books, acc = warm_chain(books, warm_rounds)  # steady-state books
+        books0, acc = warm_chain(books, warm_rounds)  # steady-state books
         int(acc[0])
-        books0 = jax.tree.map(jnp.copy, books)
-        int(jnp.sum(books0.count))
         # Untimed pass: compile the timed chain.
-        books, acc = timed_chain(jax.tree.map(jnp.copy, books0), timed_rounds)
+        _, acc = timed_chain(books0, timed_rounds)
         int(acc[0])
 
         # The timed region ends with ONE scalar fetch, which costs ~85ms
         # over the tunnel — far more than the device work of a single chain
         # at these config sizes. Chain the whole timeline CHAIN_REPS times
-        # back-to-back (async dispatches pipeline; books carry over at
-        # steady state) so the fetch amortizes to noise.
+        # back-to-back (async dispatches pipeline) so the fetch amortizes
+        # to noise. Each rep REPLAYS the identical timeline from the same
+        # post-warmup books (an async device-side copy, no host sync):
+        # carrying books across reps deepened the Zipf hot lanes without
+        # bound — ~108K silently dropped rests per r4-style run at
+        # cap=256 — so the replay is both the honest measurement and the
+        # overflow-free one.
         chain_reps = int(
             os.environ.get(
                 "BENCH_CHAIN_REPS", max(1, 1_000_000 // max(timed_orders, 1))
@@ -1424,12 +1483,11 @@ def main():
         elapsed = float("inf")
         overflows = 0
         for _ in range(max(1, REPEATS)):
-            books = jax.tree.map(jnp.copy, books0)
-            int(jnp.sum(books.count))  # barrier: copy completes off-clock
+            int(jnp.sum(books0.count))  # barrier: state settled off-clock
             acc = None
             t0 = time.perf_counter()
             for _ in range(chain_reps):
-                books, a = timed_chain(books, timed_rounds)
+                _, a = timed_chain(books0, timed_rounds)
                 acc = a if acc is None else add(acc, a)
             totals = np.asarray(jax.device_get(acc), np.int64)
             pass_elapsed = time.perf_counter() - t0
@@ -1464,7 +1522,7 @@ def main():
             print(
                 f"# elapsed={elapsed:.3f}s applied={timed_orders} "
                 f"x{chain_reps} reps, rounds={len(timed_rounds)} "
-                f"shapes={shapes[:8]}... "
+                f"shapes={shapes[:8]}... caps={timed_caps[:8]}... "
                 f"platform={jax.devices()[0].platform}",
                 file=sys.stderr,
             )

@@ -94,8 +94,21 @@ def batch_step(
     config: BookConfig, books: BookState, ops: DeviceOp
 ) -> tuple[BookState, StepOutput]:
     """books: [S, ...] stacked BookState; ops: DeviceOp with [S, T] leaves.
-    Returns updated books and [S, T]-shaped StepOutputs."""
-    return jax.vmap(lambda b, o: _lane_scan_impl(config, b, o))(books, ops)
+    Returns updated books and [S, T]-shaped StepOutputs.
+
+    The stack's slot axis may be WIDER than config.cap (a per-grid cap
+    class, VERDICT r4 #2): the step then runs on the [.., :cap] slice —
+    per-step cost tracks the grid's own depth class, not the storage cap
+    one hot lane escalated — and writes the slice back. Exactness is
+    guarded by _guard_capped."""
+    cap = config.cap
+    sub = _slice_books_cap(books, cap)
+    pre_counts = books.count
+    sub, outs = jax.vmap(lambda b, o: _lane_scan_impl(config, b, o))(sub, ops)
+    outs = _guard_capped(outs, pre_counts, cap, ops)
+    if books.price.shape[-1] == cap:
+        return sub, outs
+    return _writeback_full_cap(books, sub, cap), outs
 
 
 lane_scan = functools.partial(jax.jit, static_argnums=0)(_lane_scan_impl)
@@ -121,15 +134,21 @@ def dense_batch_step(
     out-of-range sentinel (>= S). Sentinel rows gather zero books
     (mode="fill"), scan pure-NOP op rows (the packer guarantees this), and
     are dropped by the scatter (mode="drop") — no aliasing, no branches.
+
+    Like batch_step, the gather restricts the slot axis to config.cap —
+    the grid's cap class — so tail-lane grids never pay a hot lane's
+    escalated storage depth (_guard_capped covers mis-classed lanes).
     """
+    cap = config.cap
+    base = _slice_books_cap(books, cap)
     sub = jax.tree.map(
         lambda a: jnp.take(a, lane_ids, axis=0, mode="fill", fill_value=0),
-        books,
+        base,
     )
+    pre_counts = sub.count
     sub, outs = jax.vmap(lambda b, o: _lane_scan_impl(config, b, o))(sub, ops)
-    new_books = jax.tree.map(
-        lambda a, s: a.at[lane_ids].set(s, mode="drop"), books, sub
-    )
+    outs = _guard_capped(outs, pre_counts, cap, ops)
+    new_books = _scatter_books_cap(books, lane_ids, sub, cap)
     return new_books, outs
 
 
@@ -148,20 +167,50 @@ def dense_kernel_step(
     pays XLA kernel-launch overhead on a sequential dependency chain) and
     the in-kernel fori_loop running entirely out of VMEM — the single-hot-
     symbol latency path lives here. Row count must satisfy the kernel's
-    blocking rule (the packer pads rows to >= 8, a power of two)."""
+    blocking rule (the packer pads rows to >= 8, a power of two).
+
+    Cap-class slicing as in dense_batch_step; a shallower class also
+    shrinks the kernel's VMEM book tile, letting wider lane blocks fit."""
     from ..ops import pallas_batch_step
 
+    cap = config.cap
+    base = _slice_books_cap(books, cap)
     sub = jax.tree.map(
         lambda a: jnp.take(a, lane_ids, axis=0, mode="fill", fill_value=0),
-        books,
+        base,
     )
+    pre_counts = sub.count
     sub, outs = pallas_batch_step(
         config, sub, ops, block_s=block_s, interpret=interpret
     )
-    new_books = jax.tree.map(
-        lambda a, s: a.at[lane_ids].set(s, mode="drop"), books, sub
-    )
+    outs = _guard_capped(outs, pre_counts, cap, ops)
+    new_books = _scatter_books_cap(books, lane_ids, sub, cap)
     return new_books, outs
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 4))
+def full_kernel_step(
+    config: BookConfig,
+    books: BookState,
+    ops: DeviceOp,
+    block_s: int,
+    interpret: bool = False,
+):
+    """Full-grid (row == lane) Pallas step with the cap-class slice/guard/
+    write-back of batch_step — pallas_batch_step itself requires the book
+    arrays at exactly config.cap."""
+    from ..ops import pallas_batch_step
+
+    cap = config.cap
+    sub = _slice_books_cap(books, cap)
+    pre_counts = books.count
+    sub, outs = pallas_batch_step(
+        config, sub, ops, block_s=block_s, interpret=interpret
+    )
+    outs = _guard_capped(outs, pre_counts, cap, ops)
+    if books.price.shape[-1] == cap:
+        return sub, outs
+    return _writeback_full_cap(books, sub, cap), outs
 
 
 def _nop_grid(config: BookConfig, n_slots: int, t: int) -> dict[str, np.ndarray]:
@@ -178,6 +227,99 @@ def _next_pow2(n: int) -> int:
     while p < n:
         p *= 2
     return p
+
+
+#: Smallest per-grid cap class. Below this the fixed per-step cost dominates
+#: (the roofline in ARCHITECTURE.md prices slot work at ~11 cycles/slot past
+#: 128 and ~nothing below), so finer classes would only multiply compiled
+#: shapes. Also keeps every class >= the default max_fills record budget.
+CAP_CLASS_MIN = 64
+
+
+def _cap_ladder(cap: int) -> list[int]:
+    """The per-grid cap classes available under a storage cap: pow4 steps
+    from CAP_CLASS_MIN (64, 256, 1024, ...) strictly below `cap`, plus
+    `cap` itself. Pow4 bounds the compiled-shape count at <=4x padding —
+    the same trade _next_pow4 makes for train-grid rows. A storage cap at
+    or below CAP_CLASS_MIN yields a single class (today's behavior:
+    every grid runs at the storage cap)."""
+    if cap <= CAP_CLASS_MIN:
+        return [cap]
+    out = []
+    c = CAP_CLASS_MIN
+    while c < cap:
+        out.append(c)
+        c *= 4
+    out.append(cap)
+    return out
+
+
+def _slice_books_cap(books: BookState, cap: int) -> BookState:
+    """Restrict the slot axis to the leading `cap` slots (no-op at the
+    storage width). Exact for every lane whose resting count <= cap —
+    active slots are a prefix — and _guard_capped turns any deeper lane
+    into a book_overflow so the escalation machinery re-runs the grid at
+    a deeper class instead of silently dropping its tail."""
+    if books.price.shape[-1] == cap:
+        return books
+    cut = lambda a: a[..., :cap]
+    return books._replace(
+        price=cut(books.price), lots=cut(books.lots), seq=cut(books.seq),
+        oid=cut(books.oid), uid=cut(books.uid),
+    )
+
+
+def _guard_capped(outs: StepOutput, pre_counts, cap: int,
+                  ops: DeviceOp) -> StepOutput:
+    """Flag rows whose PRE-step resting count exceeds the grid's cap class:
+    their books were truncated by the slice, so the grid's result for them
+    is not trustworthy. Folding the flag into book_overflow reuses the
+    exact escalation/fallback path — a stale host-side depth estimate
+    costs a re-run, never correctness. (Growth DURING the grid past cap is
+    the ordinary insert overflow and needs no guard.)
+
+    Rows with no real op are exempt: NOPs never read or write book slots,
+    so a deep lane riding a shallow-class grid as padding is exact — in a
+    class-partitioned full grid (engine.frames._class_partitions) every
+    OTHER class's lanes are exactly such rows."""
+    touched = jnp.any(ops.action != 0, axis=-1)
+    bad = (
+        touched & (jnp.max(pre_counts, axis=-1) > cap)
+    ).astype(outs.book_overflow.dtype)
+    return outs._replace(
+        book_overflow=jnp.maximum(outs.book_overflow, bad[:, None])
+    )
+
+
+def _writeback_full_cap(books: BookState, sub: BookState, cap: int):
+    """Write a cap-sliced full-grid result back into the storage-width
+    stack (row == lane; slots beyond `cap` were untouched by the grid)."""
+    put = lambda a, s: a.at[..., :cap].set(s)
+    return books._replace(
+        price=put(books.price, sub.price), lots=put(books.lots, sub.lots),
+        seq=put(books.seq, sub.seq), oid=put(books.oid, sub.oid),
+        uid=put(books.uid, sub.uid), count=sub.count,
+        next_seq=sub.next_seq,
+    )
+
+
+def _scatter_books_cap(books: BookState, lane_ids, sub: BookState, cap: int):
+    """Scatter a dense grid's sub-stack back, writing only the leading
+    `cap` slots of each touched lane (sentinel rows drop). Lanes in a
+    cap-class grid hold nothing beyond `cap` (guarded above), so the
+    untouched tail slots stay zero and every book invariant holds."""
+    if books.price.shape[-1] == cap:
+        return jax.tree.map(
+            lambda a, s: a.at[lane_ids].set(s, mode="drop"), books, sub
+        )
+    put3 = lambda a, s: a.at[lane_ids, :, :cap].set(s, mode="drop")
+    put = lambda a, s: a.at[lane_ids].set(s, mode="drop")
+    return books._replace(
+        price=put3(books.price, sub.price), lots=put3(books.lots, sub.lots),
+        seq=put3(books.seq, sub.seq), oid=put3(books.oid, sub.oid),
+        uid=put3(books.uid, sub.uid), count=put(books.count, sub.count),
+        next_seq=put(books.next_seq, sub.next_seq),
+    )
 
 
 def _next_pow4(n: int) -> int:
@@ -253,6 +395,10 @@ class EngineStats:
     dropped_no_prepool: int = 0  # incremented by the orchestrator facade
     device_calls: int = 0
     cap_escalations: int = 0
+    # Confined escalations: one GRID's cap class deepened (re-sliced from
+    # the same storage) without growing the [S]-wide stack — the cheap
+    # recovery per-grid cap classes buy (cap_escalations = storage grew).
+    grid_cap_escalations: int = 0
     fill_record_escalations: int = 0
     frame_fallbacks: int = 0  # fast-path frames re-run on the exact path
     lane_growths: int = 0
@@ -331,8 +477,21 @@ class BatchEngine:
         self.dense_t_max = dense_t_max
         # Grow-only geometry ratchets (see _grid_geometry / frame packing):
         # compiled grid shapes must not oscillate across pow2 buckets.
-        self._dense_rows_floor = 8
-        self._dense_t_floor = 8
+        # Keyed by CAP CLASS (_cap_ladder): each class runs its own grid
+        # train with its own row/depth profile — the tail class's 10K-row
+        # floor must never inflate the hot class's 8-row grids (and vice
+        # versa for depth).
+        self._dense_rows_floor: dict[int, int] = {}
+        self._dense_t_floor: dict[int, int] = {}
+        # Per-lane resting-count upper bound, the host-side input to cap-
+        # class selection (frames._class_partitions): ub = _ub_base (true
+        # per-lane max-side counts at the last device fetch) + _ub_extra
+        # (limit-ADDs packed since — each can rest at most once, and
+        # nothing else ever raises a count, so base+extra is provably an
+        # upper bound). It is a PERFORMANCE hint only: an underestimate is
+        # caught on device by _guard_capped and re-run deeper.
+        self._ub_base = np.zeros(n_slots, np.int64)
+        self._ub_extra = np.zeros(n_slots, np.int64)
         # Compaction-buffer ratchets (frames._compact_sizes): grow-only
         # fetch-buffer sizes, keyed by the grid's pow2 op-count class. A
         # frame can contain grids of wildly different sizes (a Zipf flow
@@ -403,6 +562,40 @@ class BatchEngine:
         self._base_set = np.pad(self._base_set, (0, pad))
         self._env_lo = np.pad(self._env_lo, (0, pad))
         self._env_hi = np.pad(self._env_hi, (0, pad))
+        self._ub_base = np.pad(self._ub_base, (0, pad))
+        self._ub_extra = np.pad(self._ub_extra, (0, pad))
+
+    # -- resting-count upper bound (cap-class selection) -------------------
+    def count_ub(self) -> np.ndarray:
+        """Current per-lane upper bound on max-side resting count."""
+        return self._ub_base + self._ub_extra
+
+    def note_packed_adds(self, add_counts: np.ndarray) -> None:
+        """Record a packed batch's per-lane limit-ADD counts (each may rest
+        at most once, keeping count_ub an upper bound). add_counts is
+        [n_slots] at pack time; callers keep it for _note_exact_counts."""
+        self._ub_extra[: len(add_counts)] += add_counts
+
+    def _note_exact_counts(self, counts_max, resolved_adds=None) -> None:
+        """Reset the estimate from a device fetch of true per-lane max-side
+        counts (taken AFTER some batch B executed). resolved_adds = B's own
+        note_packed_adds increments when later batches are already packed
+        on top (the frame pipeline resolves FIFO, so extra minus B's share
+        is exactly the still-in-flight sum); None asserts nothing is in
+        flight and zeroes extra."""
+        n = self.n_slots
+        base = np.zeros(n, np.int64)
+        m = min(len(counts_max), n)
+        base[:m] = np.asarray(counts_max[:m], np.int64)
+        self._ub_base = base
+        if resolved_adds is None:
+            self._ub_extra = np.zeros(n, np.int64)
+        else:
+            extra = self._ub_extra.copy()
+            m = min(len(resolved_adds), n)
+            extra[:m] -= np.asarray(resolved_adds[:m], np.int64)
+            np.maximum(extra, 0, out=extra)
+            self._ub_extra = extra
 
     def _prepare_bases(self, pending, lanes) -> np.ndarray:
         """Set / recenter per-lane price bases so every ADMITTED price in
@@ -475,15 +668,24 @@ class BatchEngine:
         its flow's geometry (from a previous run or a staging soak)
         pre-warms here so every shape compiles during warmup instead of
         mid-traffic. Purely a performance knob — untouched ratchets grow
-        on demand exactly as before."""
+        on demand exactly as before.
+
+        rows_floor/t_floor accept an int (a floor for the storage-cap
+        class — the pre-cap-class behavior) or a {cap class: floor} dict
+        as returned by geometry_floors()."""
+
+        def merge(dst: dict, src) -> None:
+            items = (
+                src.items() if isinstance(src, dict)
+                else [(self.config.cap, src)]
+            )
+            for c, v in items:
+                dst[c] = max(dst.get(c, 8), _next_pow2(max(int(v), 8)))
+
         if rows_floor is not None:
-            self._dense_rows_floor = max(
-                self._dense_rows_floor, _next_pow2(max(rows_floor, 8))
-            )
+            merge(self._dense_rows_floor, rows_floor)
         if t_floor is not None:
-            self._dense_t_floor = max(
-                self._dense_t_floor, _next_pow2(max(t_floor, 8))
-            )
+            merge(self._dense_t_floor, t_floor)
         if fills_buf is not None:
             _merge_buf_floor(self._fills_buf_floor, fills_buf)
         if cancels_buf is not None:
@@ -493,17 +695,19 @@ class BatchEngine:
         """The current grow-only shape ratchets (see prewarm_geometry) —
         what a warmup loop watches to decide the flow's compiled shapes
         have stabilized, and what a deployment records to pre-warm the
-        next process. The buffer floors are {pow2 op-class: slots} dicts;
-        everything is copied (safe to hold across further frames)."""
+        next process. rows_floor/t_floor are {cap class: floor} dicts, the
+        buffer floors {pow2 op-class: slots} dicts; everything is copied
+        (safe to hold across further frames)."""
         return dict(
-            rows_floor=self._dense_rows_floor,
-            t_floor=self._dense_t_floor,
+            rows_floor=dict(self._dense_rows_floor),
+            t_floor=dict(self._dense_t_floor),
             fills_buf=dict(self._fills_buf_floor),
             cancels_buf=dict(self._cancels_buf_floor),
             cap=self.config.cap,
         )
 
-    def _grid_geometry(self, live: np.ndarray, first: bool = True):
+    def _grid_geometry(self, live: np.ndarray, first: bool = True,
+                       cls: int | None = None):
         """Grid geometry decision, shared by the object packer and the
         frame path (engine.frames): when the batch touches few of the
         provisioned lanes, pack a compact grid over just the live lanes
@@ -530,13 +734,18 @@ class BatchEngine:
         live count, so the dense win shrinks as skew concentrates on one
         shard — which is the true cost surface on hardware.
 
+        `cls` keys the grow-only floors by the grid's cap class (per-class
+        trains have independent row/depth profiles); None = the storage
+        cap class (the single-class behavior).
+
         Returns (use_dense, n_rows, lane_ids, row_of): lane_ids [n_rows]
         GLOBAL lane ids with sentinel n_slots on padding rows (the device
         step localizes under a mesh); row_of [n_slots] maps live lane ->
         row (valid only at live positions). Both None for full grids."""
         if not (self.dense and len(live) > 0):
             return False, self.n_slots, None, None
-        floor = self._dense_rows_floor if first else 8
+        cls = self.config.cap if cls is None else cls
+        floor = self._dense_rows_floor.get(cls, 8) if first else 8
         bucket = _next_pow2 if first else _next_pow4
         if self.mesh is None:
             n_rows = max(8, bucket(len(live)), floor)
@@ -547,7 +756,7 @@ class BatchEngine:
             # shape frame to frame — and one fresh XLA compile costs more
             # than thousands of frames of matching.
             if first:
-                self._dense_rows_floor = n_rows
+                self._dense_rows_floor[cls] = n_rows
             lane_ids = np.full(n_rows, self.n_slots, np.int64)
             lane_ids[: len(live)] = live
             rows_for_live = np.arange(len(live), dtype=np.int64)
@@ -560,7 +769,7 @@ class BatchEngine:
             if r_s * d >= self.n_slots:
                 return False, self.n_slots, None, None
             if first:
-                self._dense_rows_floor = r_s
+                self._dense_rows_floor[cls] = r_s
             n_rows = r_s * d
             lane_ids = np.full(n_rows, self.n_slots, np.int64)
             starts = np.zeros(d, np.int64)
@@ -655,6 +864,7 @@ class BatchEngine:
             self.books, self.config, self.n_slots,
             self.price_base.copy(), self._base_set.copy(),
             self._env_lo.copy(), self._env_hi.copy(),
+            self._ub_base.copy(), self._ub_extra.copy(),
         )
 
     def _restore(self, cp) -> None:
@@ -665,12 +875,14 @@ class BatchEngine:
         let the interim mutations corrupt the checkpoint itself."""
         (
             self.books, self.config, self.n_slots,
-            price_base, base_set, env_lo, env_hi,
+            price_base, base_set, env_lo, env_hi, ub_base, ub_extra,
         ) = cp
         self.price_base = price_base.copy()
         self._base_set = base_set.copy()
         self._env_lo = env_lo.copy()
         self._env_hi = env_hi.copy()
+        self._ub_base = ub_base.copy()
+        self._ub_extra = ub_extra.copy()
 
     def process(self, orders: list[Order]) -> list[MatchResult]:
         """Apply a micro-batch. Symbols with more than max_t ops are drained
@@ -756,6 +968,8 @@ class BatchEngine:
                 arr[lane, t] = getattr(op, name)
             contexts[(lane, t)] = (arrival, order)
             fill_level[lane] = t + 1
+            if order.action is Action.ADD and not op.is_market:
+                self._ub_extra[lane] += 1  # count_ub upper-bound upkeep
         return DeviceOp(**grid), contexts, leftover
 
     def process_columnar(self, orders: list[Order]):
@@ -856,12 +1070,13 @@ class BatchEngine:
                 )
                 // 2,
             )
+            t_floor = self._dense_t_floor.get(self.config.cap, 8)
             t_grid = min(
-                max(_next_pow2(max(level.values())), self._dense_t_floor),
+                max(_next_pow2(max(level.values())), t_floor),
                 max(self.dense_t_max, self.max_t),
                 t_mem,
             )
-            self._dense_t_floor = max(self._dense_t_floor, t_grid)
+            self._dense_t_floor[self.config.cap] = max(t_floor, t_grid)
         else:
             row = lanes
             t_grid = self.max_t
@@ -879,6 +1094,14 @@ class BatchEngine:
             rec[5] = oids.intern(o.oid)
             rec[6] = uids.intern(o.uuid)
         adds = packed & (table[:, 0] == int(Action.ADD))
+        # Keep count_ub an upper bound across paths: every packed limit ADD
+        # may rest once (the frame path's increments live in
+        # frames._frame_arrays; this is the object-path equivalent).
+        rest_candidates = adds & (table[:, 2] == 0)
+        if rest_candidates.any():
+            self._ub_extra += np.bincount(
+                lanes[rest_candidates], minlength=self.n_slots
+            )
         bad = adds & (table[:, 4] <= 0)
         if bad.any():
             i = int(np.nonzero(bad)[0][0])
@@ -973,7 +1196,8 @@ class BatchEngine:
             decoded.append((arrival, events))
         return leftover
 
-    def _run_exact(self, ops: DeviceOp, contexts, lane_ids=None):
+    def _run_exact(self, ops: DeviceOp, contexts, lane_ids=None,
+                   cap_g: int | None = None):
         """Run one grid, escalating device budgets until nothing overflowed.
 
         Returns (outs, lane_overrides): the committed [R, T] outputs plus,
@@ -982,15 +1206,23 @@ class BatchEngine:
 
         lane_ids: for a dense grid, the [R] row -> lane mapping (sentinel
         >= n_slots on padding rows); None for full grids (row == lane).
+
+        cap_g: the grid's cap class (None = the storage cap). Overflow
+        first deepens the CLASS — a re-slice of the same storage, confined
+        to this grid — and only grows the [S]-wide storage once the grid
+        already runs at the full storage cap.
         """
         books_before = self.books  # immutable on device; cheap to retain
+        if cap_g is None:
+            cap_g = self.config.cap
 
         def lane_of(row: int) -> int:
             return row if lane_ids is None else int(lane_ids[row])
 
         # Phase 1: book capacity. A tripped `book_overflow` means a resting
-        # insert was dropped — the book state is NOT what the sequential
-        # semantics require, so grow the slot axis and replay the whole grid
+        # insert was dropped (or the grid's cap class sliced away a lane's
+        # resting tail — _guard_capped) — the result is NOT what the
+        # sequential semantics require, so deepen and replay the whole grid
         # from the snapshot (exact: active slots are a prefix; padding is
         # invisible to matching). The new cap targets the host-side bound
         # (current resting count plus the ADDs packed into the lane) but
@@ -998,12 +1230,11 @@ class BatchEngine:
         # grids converge in a few exact replays instead of one wildly
         # oversized jump.
         while True:
-            new_books, outs = self._step(books_before, ops, lane_ids)
+            new_books, outs = self._step(books_before, ops, lane_ids, cap_g)
             self.stats.device_calls += 1
             host_flags = np.asarray(jax.device_get(outs.book_overflow))
             if not host_flags.any():
                 break
-            self.stats.cap_escalations += 1
             counts = np.asarray(jax.device_get(books_before.count))  # [S, 2]
             adds_per_row = np.sum(
                 np.asarray(ops.action) == ACTION_ADD, axis=1
@@ -1019,6 +1250,18 @@ class BatchEngine:
                     0,
                 )
             bound = int((row_counts + adds_per_row).max())
+            if cap_g < self.config.cap:
+                # Confined escalation: this grid re-runs on a deeper slice
+                # of the SAME storage; the other grids and the stack are
+                # untouched. Snap to the class ladder so the replay reuses
+                # a compiled shape.
+                self.stats.grid_cap_escalations += 1
+                target = max(min(bound, 4 * cap_g), cap_g + 1)
+                cap_g = next(
+                    (c for c in _cap_ladder(self.config.cap) if c >= target),
+                    self.config.cap,
+                )
+                continue
             # The bound assumes EVERY packed ADD rests — with deep dense
             # grids (thousands of ADDs on a hot row) that overshoots the
             # true requirement by orders of magnitude, and cap is global
@@ -1026,6 +1269,7 @@ class BatchEngine:
             # stack is gigabytes). Grow at most 4x per escalation: the
             # replay loop converges in log4 steps to the smallest
             # sufficient pow2, each step exact.
+            self.stats.cap_escalations += 1
             new_cap = _next_pow2(
                 max(min(bound, 4 * self.config.cap), self.config.cap + 1)
             )
@@ -1037,6 +1281,7 @@ class BatchEngine:
                 )
             books_before = self._place(grow_books(books_before, new_cap))
             self.config = dataclasses.replace(self.config, cap=new_cap)
+            cap_g = new_cap
         self.books = new_books
         outs = jax.device_get(outs)
 
@@ -1066,14 +1311,22 @@ class BatchEngine:
             lane_overrides[row] = jax.device_get(lane_out)
         return outs, lane_overrides
 
-    def _step(self, books: BookState, ops: DeviceOp, lane_ids=None):
+    def _step(self, books: BookState, ops: DeviceOp, lane_ids=None,
+              cap_g: int | None = None):
         """Run one [R, T] grid with the configured kernel. lane_ids selects
         the dense gather/scatter step (compact grid over live lanes; under
         a mesh the rows are laid out per shard and the gather runs inside
         shard_map — parallel.mesh.sharded_dense_step). The Pallas path
         requires S % block_s == 0 (n_slots growth keeps powers of two) and
         interprets off-TPU; escalation re-runs (lane_scan) stay on the scan
-        path — they are rare and per-lane."""
+        path — they are rare and per-lane.
+
+        cap_g: the grid's cap class (None/equal = storage cap). Every step
+        variant slices the slot axis to it, so the per-step cost tracks
+        this grid's own depth class."""
+        cfg = self.config
+        if cap_g is not None and cap_g != cfg.cap:
+            cfg = dataclasses.replace(cfg, cap=cap_g)
         if lane_ids is not None and self.mesh is not None:
             from ..parallel.mesh import shard_batch, sharded_dense_step
 
@@ -1086,15 +1339,15 @@ class BatchEngine:
             ids_local = np.where(
                 ids_np >= self.n_slots, local, ids_np % local
             ).astype(np.int32)
-            stepper = self._sharded_dense_steppers.get(self.config)
+            stepper = self._sharded_dense_steppers.get(cfg)
             if stepper is None:
                 stepper = sharded_dense_step(
-                    self.config,
+                    cfg,
                     self.mesh,
                     kernel=self.kernel,
                     pallas_interpret=self._pallas_interpret,
                 )
-                self._sharded_dense_steppers[self.config] = stepper
+                self._sharded_dense_steppers[cfg] = stepper
             return stepper(
                 books,
                 shard_batch(self.mesh, jnp.asarray(ids_local)),
@@ -1110,57 +1363,53 @@ class BatchEngine:
                 )
 
                 r = ops.action.shape[0]
-                block_s = default_block_s(r, self.config.cap)
+                block_s = default_block_s(r, cfg.cap)
                 if self._pallas_interpret and block_s is None:
                     block_s = interpret_block_s(r)
                 if block_s is not None and (
-                    pallas_available(self.config.dtype)
+                    pallas_available(cfg.dtype)
                     or self._pallas_interpret
                 ):
                     return dense_kernel_step(
-                        self.config, books, ids, ops, block_s,
-                        not pallas_available(self.config.dtype),
+                        cfg, books, ids, ops, block_s,
+                        not pallas_available(cfg.dtype),
                     )
-            return dense_batch_step(self.config, books, ids, ops)
+            return dense_batch_step(cfg, books, ids, ops)
         if self.mesh is not None:
             from ..parallel.mesh import shard_batch, sharded_batch_step
 
-            stepper = self._sharded_steppers.get(self.config)
+            stepper = self._sharded_steppers.get(cfg)
             if stepper is None:
                 stepper = sharded_batch_step(
-                    self.config,
+                    cfg,
                     self.mesh,
                     kernel=self.kernel,
                     pallas_interpret=self._pallas_interpret,
                 )
-                self._sharded_steppers[self.config] = stepper
+                self._sharded_steppers[cfg] = stepper
             return stepper(books, shard_batch(self.mesh, ops))
         if self.kernel == "pallas":
             from ..ops import (
                 default_block_s,
                 interpret_block_s,
                 pallas_available,
-                pallas_batch_step,
             )
 
             s = ops.action.shape[0]
-            block_s = default_block_s(s, self.config.cap)
+            block_s = default_block_s(s, cfg.cap)
             if self._pallas_interpret and block_s is None:
                 block_s = interpret_block_s(s)
             if block_s is not None and (
-                pallas_available(self.config.dtype) or self._pallas_interpret
+                pallas_available(cfg.dtype) or self._pallas_interpret
             ):
-                return pallas_batch_step(
-                    self.config,
-                    books,
-                    ops,
-                    block_s=block_s,
-                    interpret=not pallas_available(self.config.dtype),
+                return full_kernel_step(
+                    cfg, books, ops, block_s,
+                    not pallas_available(cfg.dtype),
                 )
             # int64 books, off-TPU, or lane counts the kernel cannot block:
             # the scan path has identical semantics at full speed (the
             # interpreter is a test vehicle, not a production fallback).
-        return batch_step(self.config, books, ops)
+        return batch_step(cfg, books, ops)
 
     # -- snapshot support ----------------------------------------------------
     def export_state(self) -> dict:
@@ -1226,6 +1475,10 @@ class BatchEngine:
         self.uids = Interner.from_list(list(state["uids"]))
         self._rebase = jnp.dtype(self.config.dtype).itemsize <= 4
         n = self.n_slots
+        # count_ub restarts exact from the restored books (nothing in
+        # flight after a restore).
+        self._ub_base = np.asarray(b["count"], np.int64).max(axis=1)
+        self._ub_extra = np.zeros(n, np.int64)
         if "price_base" in state:
             self.price_base = np.asarray(state["price_base"], np.int64).copy()
             self._base_set = np.asarray(state["base_set"], bool).copy()

@@ -178,11 +178,21 @@ def _frame_arrays(eng: BatchEngine, cols: dict) -> dict:
             occ = np.arange(len(sorted_lanes)) - group_start
             t[ki[order]] = occ
 
+    # count_ub upkeep (cap-class selection, batch.py): every kept limit
+    # ADD may rest at most once. The increment happens at PACK time — the
+    # classes chosen below then cover this frame's own worst case.
+    rest_mask = keep & is_add & (kind != MARKET)
+    add_counts = np.bincount(
+        lanes[rest_mask], minlength=eng.n_slots
+    ).astype(np.int64)
+    eng.note_packed_adds(add_counts)
+
     return dict(
         n=n, action=action, side=side, kind=kind, price=price,
         volume=volume, lanes=lanes, uid_ids=uid_ids, oid_ids=oid_ids,
         keep=keep, t=t, bases=bases,
         dels_total=int((action == ACTION_DEL).sum()),
+        add_counts=add_counts,
     )
 
 
@@ -213,28 +223,67 @@ def _scatter_grid_fn(dtype_name: str, n_rows: int, t_grid: int):
     return scatter
 
 
-def pack_frame_grids(eng: BatchEngine, a: dict) -> list[tuple]:
-    """Stage 2: split the frame into grids (lanes deeper than the grid's
-    time axis roll into the next grid — FIFO by construction), pack each
-    grid's ops as columns, and DISPATCH the device-side scatter that
-    rebuilds the padded grid on device. Returns [(ops, meta, lane_ids),
-    ...] with ops already device-resident.
+def _class_partitions(eng: BatchEngine, a: dict, active_idx):
+    """Split a frame's kept ops into per-cap-class partitions by LANE
+    (VERDICT r4 #2: stop taxing 10K shallow lanes for one hot lane's
+    escalated cap). A lane's class is the smallest ladder cap covering its
+    resting-count upper bound — count_ub already includes this frame's
+    packed ADDs (note_packed_adds runs at pack time), so within-frame
+    growth is covered and a well-estimated lane can never overflow its
+    class. Same-lane ops stay in one partition: per-symbol FIFO is
+    preserved exactly as in a single train.
 
-    The loop carries a SHRINKING active-op index set: each grid of the
-    train touches only the ops still alive at its time offset, so a
-    G-grid train (a Zipf flow draining hot lanes) costs O(sum of
-    survivors), not O(G * frame) — with 27 grids per frame the latter was
-    the consumer's dominant host cost."""
-    lanes, keep, t = a["lanes"], a["keep"], a["t"]
-    grids = []
+    Returns [(cap_class, active_idx_subset), ...], ascending by class;
+    a single-class ladder (storage cap <= CAP_CLASS_MIN) or disabled
+    dense packing degenerates to one partition at the storage cap."""
+    from .batch import _cap_ladder
+
+    ladder = _cap_ladder(eng.config.cap)
+    if len(ladder) == 1 or not eng.dense:
+        return [(eng.config.cap, active_idx)]
+    lad = np.asarray(ladder, np.int64)
+    need = eng.count_ub()[a["lanes"][active_idx]]
+    cls_i = np.minimum(np.searchsorted(lad, need), len(ladder) - 1)
+    out = []
+    for ci in np.unique(cls_i):
+        out.append((ladder[int(ci)], active_idx[cls_i == ci]))
+    return out
+
+
+def pack_frame_grids(eng: BatchEngine, a: dict) -> list[tuple]:
+    """Stage 2: split the frame into per-cap-class grid trains (lanes
+    deeper than a grid's time axis roll into the next grid — FIFO by
+    construction), pack each grid's ops as columns, and DISPATCH the
+    device-side scatter that rebuilds the padded grid on device. Returns
+    [(ops, meta, lane_ids, cap_g), ...] with ops already device-resident.
+
+    Each train's loop carries a SHRINKING active-op index set: each grid
+    touches only the ops still alive at its time offset, so a G-grid
+    train (a Zipf flow draining hot lanes) costs O(sum of survivors), not
+    O(G * frame) — with 27 grids per frame the latter was the consumer's
+    dominant host cost."""
+    keep, t = a["keep"], a["t"]
+    grids: list[tuple] = []
+    kept_idx = np.nonzero(keep)[0]
+    if not len(kept_idx):
+        return grids
+    for cap_g, part_idx in _class_partitions(eng, a, kept_idx):
+        _pack_class_train(eng, a, part_idx, t[part_idx], cap_g, grids)
+    return grids
+
+
+def _pack_class_train(eng: BatchEngine, a: dict, active_idx, t_sub,
+                      cap_g: int, grids: list) -> None:
+    """Pack one cap class's grid train (the loop body of the original
+    single-train pack_frame_grids, with geometry ratchets keyed by the
+    class)."""
+    lanes, t = a["lanes"], a["t"]
     t_off = 0
-    active_idx = np.nonzero(keep)[0]
-    t_sub = t[active_idx]
     while len(active_idx):
         live = np.unique(lanes[active_idx])
         first = t_off == 0
         use_dense, n_rows, lane_ids, row_of = eng._grid_geometry(
-            live, first=first
+            live, first=first, cls=cap_g
         )
         if use_dense:
             # Depth ratchet, like the row bucket in _grid_geometry — and
@@ -259,12 +308,11 @@ def pack_frame_grids(eng: BatchEngine, a: dict) -> list[tuple]:
             cap_t = max(8, min(max(eng.dense_t_max, eng.max_t), t_mem))
             need = int(t_sub.max()) - t_off + 1
             if first:
-                t_grid = min(
-                    max(_next_pow2(need), eng._dense_t_floor), cap_t
-                )
+                t_floor = eng._dense_t_floor.get(cap_g, 8)
+                t_grid = min(max(_next_pow2(need), t_floor), cap_t)
                 # Grow-only; a mem-clamped wide grid leaves the floor for
                 # future narrower (deeper-capable) first grids.
-                eng._dense_t_floor = max(eng._dense_t_floor, t_grid)
+                eng._dense_t_floor[cap_g] = max(t_floor, t_grid)
             else:
                 # Train tails snap to FOUR fixed depth classes (shallow /
                 # 8x-shallow / quarter-ceiling / ceiling): every distinct
@@ -341,13 +389,12 @@ def pack_frame_grids(eng: BatchEngine, a: dict) -> list[tuple]:
         ops = _scatter_grid_fn(
             np.dtype(eng.config.dtype).name, n_rows, t_grid
         )(cols, flat)
-        grids.append((ops, meta, lane_ids))
+        grids.append((ops, meta, lane_ids, cap_g))
 
         t_off += t_grid
         alive = t_sub >= t_off
         active_idx = active_idx[alive]
         t_sub = t_sub[alive]
-    return grids
 
 
 def _tables(eng):
@@ -387,14 +434,23 @@ def apply_frame(eng: BatchEngine, cols: dict):
 
     a = _frame_arrays(eng, cols)
     batches = []
-    for ops, meta, lane_ids in pack_frame_grids(eng, a):
+    for ops, meta, lane_ids, cap_g in pack_frame_grids(eng, a):
         contexts = {
             (int(r), int(tt)): None for r, tt in zip(meta["row"], meta["t"])
         }
-        outs, overrides = eng._run_exact(ops, contexts, lane_ids)
+        outs, overrides = eng._run_exact(ops, contexts, lane_ids, cap_g)
         batches.append(
             decode_grid_columnar(meta, splice_outs(outs, overrides))
         )
+    # Synchronous path, nothing in flight: re-anchor count_ub exactly so
+    # the grow-only ADD increments cannot drift classes upward forever.
+    # Only when cap classes are live (a fetch per frame is wasted work —
+    # and tunnel latency — for single-class engines).
+    from .batch import _cap_ladder
+
+    if len(_cap_ladder(eng.config.cap)) > 1 and eng._ub_extra.any():
+        counts = np.asarray(jax.device_get(eng.books.count))
+        eng._note_exact_counts(counts.max(axis=1))
     return _assemble(eng, a, batches)
 
 
@@ -571,10 +627,13 @@ class PendingFrame:
 
     def __init__(self, cols, arrays, checkpoint, items, compact, n_kept):
         self.cols = cols
-        self.arrays = arrays
+        self.arrays = arrays  # incl. add_counts for the count_ub handoff
         self.checkpoint = checkpoint
         self.items = items  # [(meta, (t_grid, K))]
-        self.compact = compact  # (totals_acc, fills_acc, cancels_acc)|None
+        # (totals_acc, fills_acc, cancels_acc, counts_max)|None — counts_max
+        # is the post-frame per-lane max-side resting count, riding the
+        # frame's single fetch to re-anchor count_ub (cap classes).
+        self.compact = compact
         self.n_kept = n_kept
 
 
@@ -603,8 +662,8 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
             totals_acc = jnp.zeros(
                 (max(_next_pow2(len(grids)), 8), 4), jnp.int32
             )
-        for g_i, (ops, meta, lane_ids) in enumerate(grids):
-            books, outs = eng._step(books, ops, lane_ids)
+        for g_i, (ops, meta, lane_ids, cap_g) in enumerate(grids):
+            books, outs = eng._step(books, ops, lane_ids, cap_g)
             eng.stats.device_calls += 1
             n_rows, t_grid = ops.action.shape
             fills_acc, cancels_acc, totals_acc = compact_accum(
@@ -623,7 +682,14 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
             items.append((meta, (t_grid, k_rec)))
         eng.books = books
         if grids:
+            from .batch import _cap_ladder
+
             compact = (totals_acc, fills_acc, cancels_acc)
+            if len(_cap_ladder(eng.config.cap)) > 1:
+                # The count_ub re-anchor rides the frame's single fetch —
+                # but only multi-class engines ever read it; single-class
+                # ones skip the [S]-wide reduction and transfer.
+                compact += (jnp.max(books.count, axis=-1),)
             for leaf in compact:
                 leaf.copy_to_host_async()
         return PendingFrame(cols, a, cp, items, compact, n_kept)
@@ -643,8 +709,10 @@ def resolve_frame(eng: BatchEngine, pend: PendingFrame):
         return _assemble(eng, pend.arrays, [])
     global FETCH_SECONDS
     t0 = time.perf_counter()
-    totals, fills_mat, cancels_mat = jax.device_get(pend.compact)
+    fetched = jax.device_get(pend.compact)
     FETCH_SECONDS += time.perf_counter() - t0
+    totals, fills_mat, cancels_mat = fetched[:3]
+    counts_max = fetched[3] if len(fetched) > 3 else None
     g = len(pend.items)
     nf_g = totals[:g, 0].astype(np.int64)
     nc_g = totals[:g, 1].astype(np.int64)
@@ -676,6 +744,13 @@ def resolve_frame(eng: BatchEngine, pend: PendingFrame):
         or total_c > cancels_mat.shape[1]
     ):
         raise _NeedExact()
+    # Re-anchor count_ub from this frame's true post-frame counts (the
+    # pipeline resolves FIFO, so extra minus THIS frame's increments is
+    # exactly the still-in-flight sum; a trip above skips this and the
+    # rollback restores the checkpointed estimate instead). None for
+    # single-class engines, which never read count_ub.
+    if counts_max is not None:
+        eng._note_exact_counts(counts_max, pend.arrays["add_counts"])
     off_f = np.concatenate(([0], np.cumsum(nf_g)))
     off_c = np.concatenate(([0], np.cumsum(nc_g)))
     batches = []

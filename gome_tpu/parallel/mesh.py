@@ -96,11 +96,8 @@ def sharded_batch_step(
 
     if use_pallas:
         shard_map = _shard_map_fn(mesh)
-        from ..ops import (
-            default_block_s,
-            interpret_block_s,
-            pallas_batch_step,
-        )
+        from ..engine.batch import full_kernel_step
+        from ..ops import default_block_s, interpret_block_s
 
         def stepper(books: BookState, ops: DeviceOp):
             s_local = ops.action.shape[0] // mesh.size
@@ -109,8 +106,11 @@ def sharded_batch_step(
                 block = interpret_block_s(s_local)
             if block is None:
                 return batch_step(config, books, ops)
-            per_chip = lambda b, o: pallas_batch_step(
-                config, b, o, block_s=block, interpret=interpret
+            # full_kernel_step carries the cap-class slice/guard/write-back
+            # (engine.batch): local book blocks may be stored wider than
+            # this grid's cap class.
+            per_chip = lambda b, o: full_kernel_step(
+                config, b, o, block, interpret
             )
             spec = P(SYM_AXIS)
             return shard_map(
@@ -157,7 +157,12 @@ def sharded_dense_step(
     rows — gathered as zero books, dropped by the scatter)."""
     sharding = symbol_sharding(mesh)
     shard_map = _shard_map_fn(mesh)
-    from ..engine.batch import _lane_scan_impl
+    from ..engine.batch import (
+        _guard_capped,
+        _lane_scan_impl,
+        _scatter_books_cap,
+        _slice_books_cap,
+    )
 
     use_pallas = False
     interpret = False
@@ -170,10 +175,15 @@ def sharded_dense_step(
     def per_chip(books, ids, ops):
         import jax.numpy as jnp
 
+        # Cap-class slice/guard/scatter, as in engine.batch.dense_*_step:
+        # the stored block may be wider than this grid's cap class.
+        cap = config.cap
+        base = _slice_books_cap(books, cap)
         sub = jax.tree.map(
             lambda a: jnp.take(a, ids, axis=0, mode="fill", fill_value=0),
-            books,
+            base,
         )
+        pre_counts = sub.count
         block = None
         if use_pallas:
             from ..ops import default_block_s, interpret_block_s
@@ -191,9 +201,8 @@ def sharded_dense_step(
             sub, outs = jax.vmap(
                 lambda b, o: _lane_scan_impl(config, b, o)
             )(sub, ops)
-        new_books = jax.tree.map(
-            lambda a, s: a.at[ids].set(s, mode="drop"), books, sub
-        )
+        outs = _guard_capped(outs, pre_counts, cap, ops)
+        new_books = _scatter_books_cap(books, ids, sub, cap)
         return new_books, outs
 
     spec = P(SYM_AXIS)

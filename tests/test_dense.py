@@ -130,9 +130,12 @@ def test_dense_int32_rebasing_btc_scale():
     eng.verify_books()
 
 
-def test_dense_never_under_mesh():
-    """Under a mesh the packer must keep the full sharded grid (a gather
-    over sharded lanes would need collectives)."""
+def test_small_mesh_falls_back_to_full_grid():
+    """Dense grids DO run under a mesh (per-shard row blocks inside
+    shard_map, parallel.mesh.sharded_dense_step) — but only when the
+    per-shard row bucket is a win. Here n_slots=8 over a 4-way mesh makes
+    r_s * d >= n_slots for any live set, so _grid_geometry must fall back
+    to the full sharded grid; events stay oracle-exact either way."""
     from gome_tpu.parallel import make_mesh
 
     mesh = make_mesh(4)
