@@ -156,8 +156,9 @@ class AmqpQueue(Queue, _Waitable):
         self._lock = threading.RLock()  # socket writes + state
         self._rpc_lock = threading.Lock()  # one outstanding sync RPC
         self._rpc_event = threading.Event()
-        self._rpc_reply: tuple | None = None
-        self._rpc_expect: tuple | None = None
+        self._rpc_reply: tuple | None = None  # (token, (cls, mth, payload))
+        self._rpc_expect: tuple | None = None  # ((cls, mth), token)
+        self._rpc_seq = 0  # correlation token source (see _rpc)
         self._buffer: list[bytes] = []  # arrival order
         self._tags: list[int] = []  # delivery tag per arrival
         self._committed = 0
@@ -261,30 +262,106 @@ class AmqpQueue(Queue, _Waitable):
                 raise ConnectionError(
                     f"AMQP connection is closed (rpc {expect})"
                 )
-            self._rpc_expect = expect
+            # Correlation token: the reader echoes the token it read from
+            # _rpc_expect back alongside the reply it stores, and the
+            # waiter validates it. This catches a descheduled reader
+            # delivering a previous RPC's reply into a fresh slot. It is
+            # defense-in-depth, not a full fix for late replies: the real
+            # guarantee is below — an RPC TIMEOUT FAILS THE CONNECTION,
+            # because once an expected reply is in flight but untracked,
+            # no tag can resynchronize the channel's request/reply stream
+            # (a same-method retry could still adopt the late reply).
+            self._rpc_seq += 1
+            token = self._rpc_seq
+            self._rpc_expect = (expect, token)
+            self._rpc_reply = None  # fresh slot: reader stores, we read
             self._rpc_event.clear()
-            with self._lock:
-                self._send(frame(FRAME_METHOD, 1, method_payload))
-            if not self._rpc_event.wait(self.SYNC_WAIT_S):
-                raise ConnectionError(f"AMQP rpc timeout waiting for {expect}")
-            reply = self._rpc_reply
-            self._rpc_expect = None
-            if reply is None:  # reader died while we waited
-                raise ConnectionError(
-                    f"AMQP connection failed while waiting for {expect}"
-                )
-            return reply
+            try:
+                with self._lock:
+                    self._send(frame(FRAME_METHOD, 1, method_payload))
+                if not self._rpc_event.wait(self.SYNC_WAIT_S):
+                    # The reply is now an untracked in-flight frame; any
+                    # further sync RPC on this channel could adopt it.
+                    # Fail the connection: callers reconnect fresh.
+                    self._closed = True
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    raise ConnectionError(
+                        f"AMQP rpc timeout waiting for {expect}; "
+                        "connection failed (reply stream unsyncable)"
+                    )
+                stored = self._rpc_reply
+                if stored is None:  # reader died while we waited
+                    raise ConnectionError(
+                        f"AMQP connection failed while waiting for {expect}"
+                    )
+                got_token, reply = stored
+                if got_token != token or (reply[0], reply[1]) != expect:
+                    raise ConnectionError(
+                        f"AMQP stale rpc reply {reply[:2]} (token "
+                        f"{got_token}), wanted {expect} (token {token})"
+                    )
+                return reply
+            finally:
+                # Cleared on EVERY exit (success, timeout, send failure):
+                # a timed-out RPC that left expect set would otherwise let
+                # its late reply be stored into the NEXT rpc's fresh slot.
+                self._rpc_expect = None
 
 
     def _send(self, data: bytes) -> None:
-        """All post-handshake writes go through here: a send that times
-        out (the heartbeat-expiry socket timeout governs sends too) or
-        fails leaves an unknown amount of a frame on the wire — the
-        connection's framing is unrecoverable, so it is marked closed and
-        the caller gets the documented ConnectionError, never a raw
-        socket.timeout followed by a desynced retry."""
+        """All post-handshake writes go through here. The socket-level
+        timeout is the heartbeat-expiry RECV bound (2*hb), which would
+        also cut off sendall() mid-frame on a slow-but-alive link (large
+        publishes up to frame_max can legitimately take longer than one
+        window). So writes loop send() with a progress check: a window
+        that moves ANY bytes resets the clock, and only two consecutive
+        zero-progress windows (~4*hb with no bytes accepted — the peer's
+        receive window has been closed for two full expiry periods) fail
+        the connection. A failed/desynced write leaves an unknown amount
+        of a frame on the wire — framing is unrecoverable, so the
+        connection is marked closed and the caller gets the documented
+        ConnectionError, never a raw socket.timeout + desynced retry.
+
+        Progress alone is not liveness: a peer trickling one byte per
+        window would reset the stall counter forever while this thread
+        holds the write lock (wedging heartbeats and every RPC behind
+        it). So the whole frame also gets an aggregate deadline — two
+        full windows of grace plus a 64 KB/s floor rate — after which a
+        technically-moving-but-dead-slow link is failed like a stalled
+        one."""
         try:
-            self._sock.sendall(data)
+            timeout = self._sock.gettimeout()
+            deadline = (
+                time.monotonic() + 2.0 * timeout + len(data) / 65536.0
+                if timeout
+                else None
+            )
+            with memoryview(data) as mv:
+                off = 0
+                stalled_windows = 0
+                while off < len(mv):
+                    if self._closed:
+                        # The reader already declared the connection dead
+                        # (heartbeat expiry / peer close); don't keep
+                        # pushing bytes at a corpse while holding _lock.
+                        raise ConnectionError("connection closed mid-send")
+                    if deadline is not None and time.monotonic() > deadline:
+                        raise socket.timeout(
+                            f"send of {len(data)}B below floor rate"
+                        )
+                    try:
+                        sent = self._sock.send(mv[off:])
+                    except socket.timeout:
+                        stalled_windows += 1
+                        if stalled_windows >= 2:
+                            raise
+                        continue
+                    if sent:
+                        stalled_windows = 0
+                    off += sent
         except (socket.timeout, OSError) as e:
             self._closed = True
             try:
@@ -332,8 +409,15 @@ class AmqpQueue(Queue, _Waitable):
                         (dtag,) = struct.unpack_from(">Q", buf, off)
                         self._pending_deliver = (dtag, bytearray(), [0])
                         continue
-                    if self._rpc_expect == (class_id, method_id):
-                        self._rpc_reply = (class_id, method_id, payload)
+                    expect = self._rpc_expect  # one read: (target, token)
+                    if expect is not None and expect[0] == (
+                        class_id,
+                        method_id,
+                    ):
+                        self._rpc_reply = (
+                            expect[1],
+                            (class_id, method_id, payload),
+                        )
                         self._rpc_event.set()
                         continue
                     if (class_id, method_id) == (10, 50):  # Connection.Close
@@ -373,8 +457,11 @@ class AmqpQueue(Queue, _Waitable):
             if not self._closed:
                 self._closed = True
             # Fail any in-flight RPC NOW (it would otherwise block its
-            # full timeout against a connection that is already dead).
-            self._rpc_reply = None
+            # full timeout against a connection that is already dead) —
+            # but never clobber a reply already stored: the reader can
+            # die right after delivering a success, and the waiter must
+            # still see it. _rpc nulls the slot before each send, so a
+            # None here means no reply genuinely arrived.
             self._rpc_event.set()
             self._notify_publish()  # wake any poll_batch waiter
 

@@ -31,6 +31,7 @@ round-trip the reference's JSON contract).
 from __future__ import annotations
 
 import struct
+from collections import OrderedDict
 
 import numpy as np
 
@@ -76,7 +77,14 @@ from ..utils.cache import IdentityCache
 # which downstream hot paths use as their own IdentityCache key (the
 # engine's symbol->lane map, the pre-pool's packed key bytes) — decoded
 # dicts are shared and must be treated as immutable.
-_dict_cache: dict[bytes, list[str]] = {}
+# The cache is module-global and SHARED across all engines/threads in the
+# process: values are immutable decoded lists (see above), so cross-thread
+# reuse is safe; mutation relies on the GIL's per-op atomicity plus
+# KeyError-tolerant eviction below. Eviction is one-entry LRU (oldest
+# insertion out, hits refreshed), so a workload with >32 live dictionaries
+# degrades to re-decoding only its coldest dict per frame instead of the
+# wholesale clear() this used to do (which evicted every hot entry too).
+_dict_cache: "OrderedDict[bytes, list[str]]" = OrderedDict()
 _DICT_CACHE_MAX = 32
 
 # Writer-side mirror: list object -> encoded uniques region (the gateway
@@ -124,9 +132,17 @@ def _read_dict_column(buf: memoryview, off: int, n: int):
     values = _dict_cache.get(region)
     if values is None:
         values = _parse_dict_uniques(region)
-        if len(_dict_cache) >= _DICT_CACHE_MAX:
-            _dict_cache.clear()
+        while len(_dict_cache) >= _DICT_CACHE_MAX:
+            try:
+                _dict_cache.popitem(last=False)  # LRU: evict oldest only
+            except KeyError:  # concurrent evictor got there first
+                break
         _dict_cache[region] = values
+    else:
+        try:
+            _dict_cache.move_to_end(region)
+        except KeyError:  # concurrently evicted; value is still valid
+            pass
     idx = np.frombuffer(buf, np.uint32, n, off)
     off += 4 * n
     return values, idx, off

@@ -88,6 +88,7 @@ class EngineService:
             self.bus,
             accuracy=e.accuracy,
             mark=self.engine.mark,
+            unmark=self.engine.unmark,
             match_feed=self.feed,
             max_volume=LOT_MAX32 if e.dtype == "int32" else None,
         )

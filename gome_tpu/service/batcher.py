@@ -62,8 +62,16 @@ class FrameBatcher:
         descheduled flusher), inverting price-time priority across
         frames. Holding the lock serializes frames in arrival order; the
         cost is submitters briefly blocking behind one frame encode
-        (~1 ms at 4K orders), which is the batching backpressure."""
+        (~1 ms at 4K orders), which is the batching backpressure.
+
+        Raises RuntimeError after close(): the deadline thread is gone,
+        so a buffered order below max_n would be stranded forever — a
+        late gRPC handler must fail loudly, not accept-and-drop."""
         with self._lock:
+            if self._stop:
+                raise RuntimeError(
+                    "FrameBatcher is closed; order not accepted"
+                )
             if not self._buf:
                 import time
 
@@ -119,8 +127,14 @@ class FrameBatcher:
                     self._wake.clear()
 
     def close(self) -> None:
-        """Flush the remainder and stop the deadline thread."""
-        self._stop = True
+        """Flush the remainder and stop the deadline thread.
+
+        _stop is set UNDER the buffer lock: any submit that already
+        passed its closed-check has appended before we get the lock, so
+        the final flush below catches it — no order can slip between the
+        check and the flush and be stranded."""
+        with self._lock:
+            self._stop = True
         self._stop_event.set()
         self._wake.set()
         self._thread.join(timeout=5)

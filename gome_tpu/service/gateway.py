@@ -59,6 +59,7 @@ class OrderGateway:
         match_feed=None,
         max_volume: int | None = None,
         batcher=None,
+        unmark=None,
     ):
         """mark: callable(Order) recording the pre-pool entry — the
         MatchEngine.mark bound method in single-binary mode. match_feed:
@@ -68,10 +69,14 @@ class OrderGateway:
         instead of raising inside the consumer batch). batcher: a
         service.batcher.FrameBatcher — accepted orders then leave as
         columnar ORDER frames (size/deadline bounded) instead of one JSON
-        document per request; admission/marking semantics are unchanged."""
+        document per request; admission/marking semantics are unchanged.
+        unmark: callable(Order) undoing a pre-pool mark — used only on the
+        shutdown race where the batcher closed between mark and emit, so a
+        rejected order never leaves a dangling marker."""
         self._bus = bus
         self._accuracy = accuracy
         self._mark = mark or (lambda order: None)
+        self._unmark = unmark or (lambda order: None)
         self._match_feed = match_feed
         self._max_volume = max_volume
         self._batcher = batcher
@@ -97,7 +102,16 @@ class OrderGateway:
         except ValueError as e:
             return pb.OrderResponse(code=3, message=f"rejected: {e}")
         self._mark(order)  # pre-pool before queueing (main.go:44-45)
-        self._emit(order)
+        try:
+            self._emit(order)
+        except (RuntimeError, ConnectionError, OSError) as e:
+            # Emit failed — batcher closed mid-shutdown (RuntimeError) or
+            # the bus connection dropped (ConnectionError/OSError). The
+            # order was NOT published, so the mark must not dangle (the
+            # consumer would never clear it) and the client must hear a
+            # rejection, not a gRPC UNKNOWN.
+            self._unmark(order)
+            return pb.OrderResponse(code=3, message=f"rejected: {e}")
         # main.go:49: unconditional success; matching outcome arrives async.
         return pb.OrderResponse(code=0, message="order accepted")
 
@@ -109,7 +123,11 @@ class OrderGateway:
         # No pre-pool mark (main.go:54-64); the consumer clears it so a
         # still-queued ADD dies (engine.go:88-90, SURVEY §2.3.3). Cancels
         # ride the same batcher so the DEL-after-ADD order is preserved.
-        self._emit(order)
+        try:
+            self._emit(order)
+        except (RuntimeError, ConnectionError, OSError) as e:
+            # Batcher closed or bus down: reject, don't crash the handler.
+            return pb.OrderResponse(code=3, message=f"rejected: {e}")
         return pb.OrderResponse(code=0, message="cancel accepted")
 
     def SubscribeMatches(self, request: pb.SubscribeRequest, context):

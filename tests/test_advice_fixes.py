@@ -243,3 +243,271 @@ def test_x64_flip_refused_after_pallas_import():
 
     assert "gome_tpu.ops.pallas_match" in sys.modules
     ensure_dtype_usable(jnp.int64)  # x64 already on: fine
+
+
+# -- round-4 advisor findings ------------------------------------------------
+
+
+def test_batcher_submit_after_close_raises():
+    """ADVICE r4 (low): submit() after close() must fail loudly — the
+    deadline thread is gone, so a silently buffered order below max_n
+    would be stranded forever."""
+    from gome_tpu.service.batcher import FrameBatcher
+
+    class _Sink:
+        def __init__(self):
+            self.frames = []
+
+        def publish(self, data):
+            self.frames.append(data)
+
+    sink = _Sink()
+    b = FrameBatcher(sink, max_n=100, max_wait_s=10.0)
+    b.submit(_add("a", 100))
+    b.close()  # flushes the remainder
+    assert len(sink.frames) == 1
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(_add("b", 100))
+    assert len(sink.frames) == 1  # nothing buffered, nothing stranded
+
+
+def test_colwire_dict_cache_lru_not_wholesale_clear():
+    """ADVICE r4 (low): >32 live dictionaries must evict one-at-a-time
+    (LRU), not clear() the whole cache — a hot dictionary stays cached
+    across an eviction storm."""
+    from gome_tpu.bus import colwire
+
+    colwire._dict_cache.clear()
+    hot = _add("h", 100, symbol="hot2usdt")
+    hot_frame = colwire.encode_orders([hot])
+    colwire.decode_order_frame(hot_frame)
+    hot_keys = set(colwire._dict_cache)  # symbol dict + uuid dict
+    assert len(hot_keys) == 2
+    # Storm: > _DICT_CACHE_MAX distinct dictionaries, re-touching the hot
+    # frame after each — under LRU the hot entry survives the storm.
+    for i in range(colwire._DICT_CACHE_MAX + 8):
+        cold = _add("c", 100, symbol=f"cold{i}2usdt")
+        colwire.decode_order_frame(colwire.encode_orders([cold]))
+        colwire.decode_order_frame(hot_frame)  # refresh
+    assert hot_keys <= set(colwire._dict_cache)
+    assert len(colwire._dict_cache) <= colwire._DICT_CACHE_MAX
+
+
+def test_amqp_send_survives_one_stalled_window():
+    """ADVICE r4 (low): the heartbeat-expiry recv timeout also bounds
+    writes; one zero-progress send window on a slow-but-alive link must
+    NOT kill the connection — only two consecutive stalled windows do."""
+    import socket as socket_mod
+
+    from gome_tpu.bus.amqp import AmqpQueue
+
+    class _SlowSock:
+        """send() times out `stall_windows` times, then accepts bytes in
+        small chunks; gettimeout() reports a tiny window so the aggregate
+        deadline math runs (and, for the trickle test, expires fast)."""
+
+        def __init__(self, stall_windows, timeout=0.05, chunk=3):
+            self.sent = bytearray()
+            self._stalls = stall_windows
+            self._timeout = timeout
+            self._chunk = chunk
+
+        def gettimeout(self):
+            return self._timeout
+
+        def send(self, mv):
+            if self._stalls > 0:
+                self._stalls -= 1
+                raise socket_mod.timeout("stalled window")
+            n = min(self._chunk, len(mv))
+            self.sent.extend(bytes(mv[:n]))
+            return n
+
+        def close(self):
+            pass
+
+    q = AmqpQueue.__new__(AmqpQueue)
+    q._closed = False
+    q._sock = _SlowSock(stall_windows=1)
+    q._send(b"hello world payload")
+    assert bytes(q._sock.sent) == b"hello world payload"
+    assert not q._closed
+
+    q2 = AmqpQueue.__new__(AmqpQueue)
+    q2._closed = False
+    q2._sock = _SlowSock(stall_windows=2)
+    with pytest.raises(ConnectionError):
+        q2._send(b"hello world payload")
+    assert q2._closed  # two consecutive dead windows: connection failed
+
+
+def test_amqp_send_trickle_hits_aggregate_deadline():
+    """Code-review follow-up: progress must not equal liveness. A peer
+    accepting one byte per (slow) window resets the stall counter every
+    time, but the per-frame aggregate deadline (2 windows + 64KB/s floor)
+    still fails the connection instead of wedging the write lock."""
+    import socket as socket_mod
+    import time as time_mod
+
+    from gome_tpu.bus.amqp import AmqpQueue
+
+    class _TrickleSock:
+        def __init__(self):
+            self.sent = 0
+
+        def gettimeout(self):
+            return 0.01  # tiny window => deadline ~0.02s + len/64K
+
+        def send(self, mv):
+            time_mod.sleep(0.005)
+            self.sent += 1
+            return 1  # one byte per call: "progress", never a timeout
+
+        def close(self):
+            pass
+
+    q = AmqpQueue.__new__(AmqpQueue)
+    q._closed = False
+    q._sock = _TrickleSock()
+    start = time_mod.monotonic()
+    with pytest.raises(ConnectionError, match="floor rate"):
+        q._send(b"x" * 4096)
+    assert time_mod.monotonic() - start < 5.0  # bounded, not 4096 windows
+    assert q._closed
+
+
+def test_amqp_reader_death_preserves_delivered_reply():
+    """ADVICE r4 (low): a reply stored just before the reader dies must
+    survive — the failure path sets the event without nulling the slot,
+    and _rpc nulls the slot before each send instead. This drives the
+    REAL _read_loop over a socketpair: the broker side delivers a valid
+    ConsumeOk method frame and immediately drops the connection."""
+    import socket as socket_mod
+    import threading
+    import time as time_mod
+
+    from gome_tpu.bus.amqp import AmqpQueue, frame, method, FRAME_METHOD
+
+    broker_side, client_side = socket_mod.socketpair()
+    q = AmqpQueue.__new__(AmqpQueue)
+    q._init_wait()
+    q._closed = False
+    q._sock = client_side
+    q._heartbeat = 0
+    q._pending_deliver = None
+    q._buffer, q._tags = [], []
+    q._lock = threading.RLock()
+    q._rpc_lock = threading.Lock()
+    q._rpc_event = threading.Event()
+    q._rpc_expect = ((60, 21), 7)  # an rpc (token 7) awaits ConsumeOk
+    q._rpc_reply = None
+    reader = threading.Thread(target=q._read_loop, daemon=True)
+    reader.start()
+
+    # Reply frame, then immediate peer death (EOF -> ConnectionError).
+    broker_side.sendall(frame(FRAME_METHOD, 1, method(60, 21)))
+    broker_side.close()
+    reader.join(timeout=5)
+    assert not reader.is_alive()
+    # The delivered ConsumeOk survived the reader's death path, with the
+    # waiter's correlation token echoed back.
+    assert q._rpc_event.is_set()
+    assert q._rpc_reply is not None
+    token, reply = q._rpc_reply
+    assert token == 7 and reply[:2] == (60, 21)
+    assert q._closed
+    client_side.close()
+
+
+def test_amqp_stale_reply_never_crosses_rpcs():
+    """Code-review follow-up: a late reply from a timed-out RPC must not
+    be handed to the NEXT rpc as its answer — even a retry of the SAME
+    method (Basic.Consume after a ConsumeOk timeout). _rpc clears
+    _rpc_expect on every exit and correlates replies by per-RPC token."""
+    import threading
+
+    from gome_tpu.bus.amqp import AmqpQueue
+
+    class _NullSock:
+        def gettimeout(self):
+            return None
+
+        def send(self, mv):
+            return len(mv)
+
+        def close(self):
+            pass
+
+    q = AmqpQueue.__new__(AmqpQueue)
+    q._closed = False
+    q._sock = _NullSock()
+    q._lock = threading.RLock()
+    q._rpc_lock = threading.Lock()
+    q._rpc_event = threading.Event()
+    q._rpc_expect = None
+    q._rpc_reply = None
+    q._rpc_seq = 0
+    q.SYNC_WAIT_S = 0.05
+
+    # RPC #1 (token 1) times out. The reply is now an untracked
+    # in-flight frame no tag can resynchronize, so the TIMEOUT FAILS THE
+    # CONNECTION — a same-method retry on this connection is refused
+    # outright instead of being allowed to adopt the late reply.
+    with pytest.raises(ConnectionError, match="timeout"):
+        q._rpc((60, 21), b"")
+    assert q._rpc_expect is None
+    assert q._closed
+    with pytest.raises(ConnectionError, match="closed"):
+        q._rpc((60, 21), b"")
+
+    # Defense-in-depth: even on a live connection, a reply stored with a
+    # previous RPC's token (descheduled reader racing the slot reset)
+    # fails the token check instead of being returned to the wrong call.
+    q._closed = False
+    def _late_reply():
+        q._rpc_reply = (1, (60, 21, b"stale"))
+        q._rpc_event.set()
+
+    threading.Timer(0.01, _late_reply).start()
+    with pytest.raises(ConnectionError, match="stale"):
+        q._rpc((60, 21), b"")
+
+
+def test_gateway_rejects_when_batcher_closed_and_unmarks():
+    """Code-review follow-up: a DoOrder racing FrameBatcher.close() must
+    return a rejection (not crash the handler with gRPC UNKNOWN) and must
+    undo its pre-pool mark — the order was never published, so nothing
+    will ever clear the marker."""
+    from gome_tpu.api import order_pb2 as pb
+    from gome_tpu.bus import MemoryQueue, QueueBus
+    from gome_tpu.service.batcher import FrameBatcher
+    from gome_tpu.service.gateway import OrderGateway
+
+    marks = []
+    bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    batcher = FrameBatcher(bus.order_queue, max_n=64, max_wait_s=10.0)
+    gw = OrderGateway(
+        bus,
+        accuracy=8,
+        mark=lambda o: marks.append(o.oid),
+        unmark=lambda o: marks.remove(o.oid),
+        batcher=batcher,
+    )
+    batcher.close()  # shutdown happened mid-flight
+    resp = gw.DoOrder(
+        pb.OrderRequest(
+            uuid="u", oid="late", symbol="eth2usdt",
+            transaction=pb.BUY, price=1.0, volume=1.0,
+        ),
+        None,
+    )
+    assert resp.code == 3 and "rejected" in resp.message
+    assert marks == []  # the mark was undone, no dangling pre-pool entry
+    cancel = gw.DeleteOrder(
+        pb.OrderRequest(
+            uuid="u", oid="late", symbol="eth2usdt",
+            transaction=pb.BUY, price=1.0, volume=0.0,
+        ),
+        None,
+    )
+    assert cancel.code == 3
