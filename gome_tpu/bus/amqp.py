@@ -299,6 +299,15 @@ class AmqpQueue(Queue, _Waitable):
                     )
                 got_token, reply = stored
                 if got_token != token or (reply[0], reply[1]) != expect:
+                    # Same unsyncable state as the timeout above: OUR
+                    # reply is still in flight and untracked, so a retry
+                    # on this connection could adopt it. Fail the
+                    # connection before raising.
+                    self._closed = True
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
                     raise ConnectionError(
                         f"AMQP stale rpc reply {reply[:2]} (token "
                         f"{got_token}), wanted {expect} (token {token})"

@@ -107,6 +107,11 @@ class EngineConfig:
     # N > 0 keeps up to N frames in flight on the device while the host
     # packs the next — engine.pipeline.FramePipeline).
     pipeline_depth: int = 0
+    # Shard the lane axis over the first N local devices as a 1-D
+    # jax.sharding.Mesh (gome_tpu.parallel.make_mesh): per-chip Pallas
+    # under shard_map, zero-collective dense grids (SURVEY §5.8). 0 = no
+    # mesh (single chip). n_slots must be a multiple of mesh_devices.
+    mesh_devices: int = 0
 
     def __post_init__(self):
         if not 0 <= self.accuracy <= 18:

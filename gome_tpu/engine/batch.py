@@ -504,6 +504,16 @@ class BatchEngine:
         # fallback keeps that safe).
         self._fills_buf_floor: dict[int, int] = {}
         self._cancels_buf_floor: dict[int, int] = {}
+        # Every fast-path (grid geometry, compact-buffer) shape combo this
+        # engine has DISPATCHED (frames.submit_frame records; tuples of
+        # (n_rows, t_grid, cap_g, dense, m_pad, k_rec, e_fills, e_cancels,
+        # totals_len)). A deployment persists these alongside the floors
+        # (shape_manifest / orchestrator.save_geometry) and replays them
+        # with all-padding inputs at boot (frames.precompile_combos), so
+        # the very first live frame runs fully traced+compiled — the
+        # trace cost (which the XLA persistent cache does NOT cover: it
+        # caches compiles, not traces) moves off every hot path.
+        self._seen_combos: set[tuple] = set()
         if mesh is not None:
             # Every place n_slots can be set (init, growth, restore) must
             # produce a mesh multiple; enforcing the two static bounds here
@@ -704,6 +714,18 @@ class BatchEngine:
             fills_buf=dict(self._fills_buf_floor),
             cancels_buf=dict(self._cancels_buf_floor),
             cap=self.config.cap,
+        )
+
+    def shape_manifest(self) -> dict:
+        """Everything a future process needs to run this flow's fast path
+        with ZERO first-seen traces: the grow-only floors (so the same
+        shapes are CHOSEN) plus every dispatched shape combo (so they are
+        TRACED+COMPILED off-clock via frames.precompile_combos). The XLA
+        persistent cache already makes compiles one-time across processes;
+        traces are per-process and this closes that gap."""
+        return dict(
+            floors=self.geometry_floors(),
+            combos=sorted(self._seen_combos),
         )
 
     def _grid_geometry(self, live: np.ndarray, first: bool = True,

@@ -389,6 +389,7 @@ def _pack_class_train(eng: BatchEngine, a: dict, active_idx, t_sub,
         ops = _scatter_grid_fn(
             np.dtype(eng.config.dtype).name, n_rows, t_grid
         )(cols, flat)
+        meta["_m_pad"] = m_pad  # host-only: shape-combo recording
         grids.append((ops, meta, lane_ids, cap_g))
 
         t_off += t_grid
@@ -680,6 +681,15 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
             # would have silently dropped records of >K-fill ops).
             k_rec = int(outs.fill_qty.shape[-1])
             items.append((meta, (t_grid, k_rec)))
+            # Record the full dispatch combo (grid geometry x frame
+            # buffers) for shape_manifest/precompile_combos: this tuple
+            # determines every jit trace the dispatch just performed.
+            eng._seen_combos.add((
+                n_rows, t_grid, int(cap_g), lane_ids is not None,
+                int(meta["_m_pad"]), k_rec,
+                int(fills_acc.shape[1]), int(cancels_acc.shape[1]),
+                int(totals_acc.shape[0]),
+            ))
         eng.books = books
         if grids:
             from .batch import _cap_ladder
@@ -830,6 +840,59 @@ def _compact_sizes(eng, n_ops: int, n_dels: int) -> tuple[int, int]:
     eng._fills_buf_floor[cls] = fills
     eng._cancels_buf_floor[cls] = cancels
     return fills, cancels
+
+
+def precompile_combos(eng: BatchEngine, combos) -> int:
+    """Replay recorded fast-path shape combos (BatchEngine.shape_manifest
+    "combos") with ALL-PADDING inputs, forcing every jit trace+compile the
+    live flow will need — scatter, step (dense or full, at the combo's cap
+    class), and frame-level compaction — before real traffic arrives.
+
+    All-padding means: scatter positions at the drop sentinel (R*T), so
+    the DeviceOp grid is all NOPs; dense lane_ids at the n_slots sentinel
+    (gathered as zero books, scattered nowhere). Book state is read but
+    results are DISCARDED — replay never mutates the engine (the step jits
+    don't donate their inputs; compact_accum donates only the dummy
+    buffers built here). Floors should be prewarmed first
+    (prewarm_geometry) so the live flow also CHOOSES these shapes.
+
+    Returns the number of combos replayed. Cost: one compile each on a
+    cold XLA cache (tens of seconds on a tunneled dev TPU), milliseconds
+    each warm — vs ~0.3-1s of un-hideable host TRACE time per shape if it
+    first appears mid-traffic (the XLA persistent cache covers compiles
+    only; traces are per-process)."""
+    wide = jnp.result_type(jnp.int32, eng.config.dtype)
+    dt = np.dtype(eng.config.dtype)
+    combos = sorted(set(map(tuple, combos)))
+    for combo in combos:
+        (
+            n_rows, t_grid, cap_g, dense, m_pad, k_rec,
+            e_fills, e_cancels, totals_len,
+        ) = combo
+        cols = np.zeros((7, m_pad), dt)
+        flat = np.full(m_pad, n_rows * t_grid, np.int32)
+        ops = _scatter_grid_fn(dt.name, n_rows, t_grid)(cols, flat)
+        lane_ids = (
+            np.full(n_rows, eng.n_slots, np.int64) if dense else None
+        )
+        _books, outs = eng._step(eng.books, ops, lane_ids, cap_g)
+        fills_acc = jnp.zeros((len(_FILL_FIELDS), e_fills), wide)
+        cancels_acc = jnp.zeros((len(_CANCEL_FIELDS), e_cancels), wide)
+        totals_acc = jnp.zeros((totals_len, 4), jnp.int32)
+        out = compact_accum(
+            eng.config, outs, fills_acc, cancels_acc, totals_acc,
+            np.int32(0),
+        )
+        # Serialize: each replay holds a transient books-sized output;
+        # blocking frees it before the next combo allocates.
+        jax.block_until_ready(out)
+        eng._seen_combos.add(combo)
+    from .batch import _cap_ladder
+
+    if len(_cap_ladder(eng.config.cap)) > 1:
+        # The count_ub re-anchor reduction that rides every frame fetch.
+        jax.block_until_ready(jnp.max(eng.books.count, axis=-1))
+    return len(combos)
 
 
 class _NeedExact(Exception):

@@ -256,6 +256,59 @@ class MatchEngine:
             )
         return cols, consumed
 
+    # -- geometry persistence ----------------------------------------------
+    def save_geometry(self, path: str) -> None:
+        """Persist the flow's shape manifest (grow-only geometry floors +
+        every dispatched fast-path shape combo) as JSON. A later process
+        load_geometry()s it so its first live frame runs with zero
+        first-seen traces — the deployment-side answer to 'per-process
+        re-traces amortizing out' (pairs with the XLA persistent compile
+        cache, which covers compiles but not traces)."""
+        import json
+        import os
+
+        m = self.batch.shape_manifest()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(m, f)
+        os.replace(tmp, path)  # atomic: readers never see a torn file
+
+    def load_geometry(self, path: str, precompile: bool = True) -> int:
+        """Load a persisted shape manifest: prewarm the grow-only floors
+        (so this process CHOOSES the recorded shapes) and, by default,
+        replay the recorded combos with all-padding inputs (so they are
+        traced+compiled before live traffic). Returns the number of combos
+        replayed (0 with precompile=False or an absent/invalid file —
+        loading is best-effort: geometry is a performance hint, never
+        state)."""
+        import json
+
+        from . import frames
+
+        try:
+            with open(path) as f:
+                m = json.load(f)
+            floors = m["floors"]
+            combos = m["combos"]
+            as_int = lambda d: {int(k): int(v) for k, v in d.items()}
+            self.batch.prewarm_geometry(
+                rows_floor=as_int(floors.get("rows_floor", {})),
+                t_floor=as_int(floors.get("t_floor", {})),
+                fills_buf=as_int(floors.get("fills_buf", {})),
+                cancels_buf=as_int(floors.get("cancels_buf", {})),
+            )
+            if not precompile:
+                self.batch._seen_combos |= set(map(tuple, combos))
+                return 0
+            return frames.precompile_combos(self.batch, combos)
+        except Exception:
+            # Best-effort end to end: a stale manifest (combo layout from
+            # an older version, shapes recorded before an n_slots growth)
+            # must never stop a boot — it is a performance hint, never
+            # state. Whatever floors merged before the failure stand
+            # (grow-only, still valid).
+            return 0
+
     @staticmethod
     def _prekey(order: Order) -> tuple[str, str, str]:
         """S:comparison field = S:U:O (ordernode.go:89-92)."""

@@ -46,10 +46,18 @@ def _shard_map_fn(mesh: Mesh):
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     """1-D mesh over the symbol axis. n_devices must divide the lane count
-    used with it."""
+    used with it. Raises when fewer than n_devices devices exist — a
+    silently smaller mesh would pass every downstream divisibility check
+    against the WRONG size and ship a topology the operator didn't ask
+    for."""
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"mesh wants {n_devices} devices but only "
+                    f"{len(devices)} are available"
+                )
             devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (SYM_AXIS,))
 

@@ -32,12 +32,18 @@ class EngineService:
         configure_logging()
         self.bus = make_bus(self.config.bus)
         e = self.config.engine
+        mesh = None
+        if e.mesh_devices:
+            from ..parallel import make_mesh
+
+            mesh = make_mesh(e.mesh_devices)
         self.engine = MatchEngine(
             config=e.book_config(),
             n_slots=e.n_slots,
             max_t=e.max_t,
             auto_grow=e.auto_grow,
             kernel=e.kernel,
+            mesh=mesh,
         )
         if self.config.store.enabled:
             # A `redis:` config section puts the pre-pool markers in the

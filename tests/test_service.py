@@ -328,3 +328,48 @@ class TestGatewayBatcherEndToEnd:
             assert got == expected
         finally:
             server.stop(grace=None)
+
+
+def test_engine_service_mesh_devices_config():
+    """EngineConfig.mesh_devices shards the service's engine over a 1-D
+    device mesh at construction — the config-level deployment knob for a
+    mesh-sharded consumer (VERDICT r4 #4)."""
+    import jax
+
+    from gome_tpu.api import order_pb2 as pb
+    from gome_tpu.config import Config, EngineConfig, GrpcConfig
+
+    svc = EngineService(
+        Config(
+            grpc=GrpcConfig(port=0),
+            engine=EngineConfig(
+                cap=16, n_slots=8, max_t=8, mesh_devices=4
+            ),
+        )
+    )
+    assert svc.engine.batch.mesh is not None
+    assert svc.engine.batch.mesh.size == 4
+    r = svc.gateway.DoOrder(
+        pb.OrderRequest(
+            uuid="u", oid="a", symbol="eth2usdt",
+            transaction=pb.SALE, price=2.0, volume=1.0,
+        ),
+        None,
+    )
+    assert r.code == 0
+    r = svc.gateway.DoOrder(
+        pb.OrderRequest(
+            uuid="u", oid="b", symbol="eth2usdt",
+            transaction=pb.BUY, price=2.0, volume=1.0,
+        ),
+        None,
+    )
+    assert r.code == 0
+    svc.pump()
+    msgs = svc.bus.match_queue.read_from(0, 100)
+    assert len(msgs) == 1  # the cross matched while sharded
+    specs = {
+        str(getattr(l.sharding, "spec", None))
+        for l in jax.tree.leaves(svc.engine.books)
+    }
+    assert "PartitionSpec('sym',)" in specs
