@@ -36,6 +36,16 @@ def add_order_servicer(server: grpc.Server, servicer) -> None:
             request_deserializer=pb.SubscribeRequest.FromString,
             response_serializer=pb.MatchEvent.SerializeToString,
         ),
+        "DoOrderBatch": grpc.unary_unary_rpc_method_handler(
+            servicer.DoOrderBatch,
+            request_deserializer=pb.OrderBatchRequest.FromString,
+            response_serializer=pb.OrderBatchResponse.SerializeToString,
+        ),
+        "DoOrderStream": grpc.stream_unary_rpc_method_handler(
+            servicer.DoOrderStream,
+            request_deserializer=pb.OrderRequest.FromString,
+            response_serializer=pb.OrderBatchResponse.SerializeToString,
+        ),
     }
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
@@ -60,4 +70,14 @@ class OrderStub:
             f"/{SERVICE_NAME}/SubscribeMatches",
             request_serializer=pb.SubscribeRequest.SerializeToString,
             response_deserializer=pb.MatchEvent.FromString,
+        )
+        self.DoOrderBatch = channel.unary_unary(
+            f"/{SERVICE_NAME}/DoOrderBatch",
+            request_serializer=pb.OrderBatchRequest.SerializeToString,
+            response_deserializer=pb.OrderBatchResponse.FromString,
+        )
+        self.DoOrderStream = channel.stream_unary(
+            f"/{SERVICE_NAME}/DoOrderStream",
+            request_serializer=pb.OrderRequest.SerializeToString,
+            response_deserializer=pb.OrderBatchResponse.FromString,
         )

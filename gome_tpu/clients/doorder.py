@@ -32,6 +32,7 @@ def load_client(
     price_lo: float = 0.01,
     price_hi: float = 1.0,
     decimals: int = 2,
+    batch_n: int = 0,
 ) -> dict:
     """Send n-1 orders (the reference's serial loop at concurrency=1; higher
     values pipeline that many in-flight requests over one HTTP/2 channel —
@@ -39,6 +40,9 @@ def load_client(
     Defaults reproduce doorder.go:38-47 exactly; `symbols` (random pick per
     order) and the price band exist for sustained benches, where the
     reference's full-range prices would pile depth without crossing.
+    batch_n > 0 switches to the amortized DoOrderBatch RPC with batch_n
+    orders per request (still `concurrency` requests in flight) — the
+    fast front door; the per-REQUEST grpc tax spreads over batch_n orders.
     Returns {sent, ok, rejected, elapsed_s, orders_per_s}."""
     rng = random.Random(seed)
     pick = symbols or [symbol]
@@ -55,33 +59,68 @@ def load_client(
                 kind=kind,
             )
 
-    sent = ok = rejected = 0
+    sent = ok = rejected = aborted = 0
     window = max(1, concurrency)
     with grpc.insecure_channel(target) as channel:
         stub = OrderStub(channel)
         t0 = time.perf_counter()
-        # One loop for both modes: a window of 1 sends request-after-response,
-        # exactly the reference's serial client.
         pending = collections.deque()
+        if batch_n > 0:
+            import itertools
 
-        def settle(f):
-            nonlocal ok, rejected
-            resp = f.result()
-            ok += resp.code == 0
-            rejected += resp.code != 0
+            def settle(f, n_chunk):
+                nonlocal ok, rejected, aborted
+                resp = f.result()
+                ok += resp.accepted
+                rejected += len(resp.reject_index)
+                # A code-3 mid-batch abort (batcher closed, bus down)
+                # leaves a tail that was neither accepted nor
+                # per-order-rejected; count it so sent == ok + rejected
+                # + aborted always holds and failures surface HERE, not
+                # as an opaque downstream count mismatch.
+                aborted += n_chunk - resp.accepted - len(resp.reject_index)
 
-        for req in requests():
-            if len(pending) >= window:
-                settle(pending.popleft())
-            pending.append(stub.DoOrder.future(req))
-            sent += 1
-        for f in pending:
-            settle(f)
+            reqs = requests()
+            while True:
+                chunk = list(itertools.islice(reqs, batch_n))
+                if not chunk:
+                    break
+                if len(pending) >= window:
+                    settle(*pending.popleft())
+                pending.append(
+                    (
+                        stub.DoOrderBatch.future(
+                            pb.OrderBatchRequest(orders=chunk)
+                        ),
+                        len(chunk),
+                    )
+                )
+                sent += len(chunk)
+            for f, n_chunk in pending:
+                settle(f, n_chunk)
+        else:
+            # One loop for both unary modes: a window of 1 sends
+            # request-after-response, exactly the reference's serial
+            # client.
+            def settle(f):
+                nonlocal ok, rejected
+                resp = f.result()
+                ok += resp.code == 0
+                rejected += resp.code != 0
+
+            for req in requests():
+                if len(pending) >= window:
+                    settle(pending.popleft())
+                pending.append(stub.DoOrder.future(req))
+                sent += 1
+            for f in pending:
+                settle(f)
         elapsed = time.perf_counter() - t0
     return {
         "sent": sent,
         "ok": ok,
         "rejected": rejected,
+        "aborted": aborted,  # batch entries lost to a mid-batch abort
         "elapsed_s": elapsed,
         "orders_per_s": sent / elapsed if elapsed > 0 else 0.0,
     }
@@ -110,6 +149,12 @@ def main(argv=None):
         kwargs["decimals"] = int(argv[6])
     if len(argv) > 7:
         kwargs["seed"] = int(argv[7])
+    if len(argv) > 8:  # orders per DoOrderBatch request (0 = unary)
+        kwargs["batch_n"] = int(argv[8])
+    if len(argv) > 9 and n_symbols:  # symbol-namespace prefix (scaling
+        kwargs["symbols"] = [  # benches give each gateway its own)
+            f"{argv[9]}sym{i}" for i in range(n_symbols)
+        ]
     stats = load_client(target, n=n, concurrency=concurrency, **kwargs)
     print(json.dumps(stats))
 

@@ -684,22 +684,67 @@ class BatchEngine:
         class — the pre-cap-class behavior) or a {cap class: floor} dict
         as returned by geometry_floors()."""
 
-        def merge(dst: dict, src) -> None:
+        def merge(dst: dict, src, cap: int) -> None:
+            """Merge grow-only, clamped to `cap`: a floor beyond the
+            usable range (rows past n_slots, depth past the dense
+            ceiling) carries no information — it just forces every grid
+            to the degenerate fallback — and persisting it would let a
+            compounding margin (e.g. 2x per run through a saved
+            manifest) poison geometry forever."""
             items = (
                 src.items() if isinstance(src, dict)
                 else [(self.config.cap, src)]
             )
             for c, v in items:
-                dst[c] = max(dst.get(c, 8), _next_pow2(max(int(v), 8)))
+                v = min(_next_pow2(max(int(v), 8)), cap)
+                dst[c] = max(dst.get(c, 8), v)
 
         if rows_floor is not None:
-            merge(self._dense_rows_floor, rows_floor)
+            merge(
+                self._dense_rows_floor, rows_floor, _next_pow2(self.n_slots)
+            )
         if t_floor is not None:
-            merge(self._dense_t_floor, t_floor)
+            merge(
+                self._dense_t_floor, t_floor,
+                _next_pow2(max(self.dense_t_max, self.max_t)),
+            )
         if fills_buf is not None:
             _merge_buf_floor(self._fills_buf_floor, fills_buf)
         if cancels_buf is not None:
             _merge_buf_floor(self._cancels_buf_floor, cancels_buf)
+
+    def reset_geometry_floors(self) -> None:
+        """Forget every grow-only geometry ratchet (rows/depth floors,
+        compaction-buffer floors). Correctness-neutral — floors are
+        performance hints — but sometimes necessary for performance:
+        ratchets latched during a WARMUP TRANSIENT (e.g. count_ub
+        overestimates while books fill from empty send hundreds of lanes
+        into a deep cap class exactly once) would otherwise pin a
+        pathologically wide-and-deep grid for the life of the process. A
+        warmup loop calls this once the flow reaches steady state, lets
+        the next frames re-ratchet from honest geometry, and THEN pins
+        margins / saves the manifest."""
+        self._dense_rows_floor.clear()
+        self._dense_t_floor.clear()
+        self._fills_buf_floor.clear()
+        self._cancels_buf_floor.clear()
+
+    def ensure_cap(self, cap: int) -> None:
+        """Pre-size book storage to `cap` slots/side (pow2-snapped,
+        grow-only, bounded by max_cap) — a deployment that knows its
+        flow's stationary depth (e.g. from a persisted geometry manifest)
+        escalates ONCE at boot instead of paying the mid-traffic
+        grow+replay, and makes deep-cap shape combos replayable by
+        precompile_combos."""
+        cap = _next_pow2(max(int(cap), self.config.cap))
+        if cap == self.config.cap:
+            return
+        if cap > self.max_cap:
+            raise CapacityError(
+                f"ensure_cap({cap}) exceeds max_cap={self.max_cap}"
+            )
+        self.books = self._place(grow_books(self.books, cap))
+        self.config = dataclasses.replace(self.config, cap=cap)
 
     def geometry_floors(self) -> dict:
         """The current grow-only shape ratchets (see prewarm_geometry) —

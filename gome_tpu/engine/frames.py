@@ -196,7 +196,8 @@ def _frame_arrays(eng: BatchEngine, cols: dict) -> dict:
     )
 
 
-@functools.lru_cache(maxsize=64)
+@functools.lru_cache(maxsize=256)  # a cap-class train set (rows x depth
+# classes x caps) can exceed 64 live shapes; eviction = silent re-trace
 def _scatter_grid_fn(dtype_name: str, n_rows: int, t_grid: int):
     """Jitted device-side grid builder for one (dtype, R, T) shape:
     packed op columns [7, m_pad] + flat positions [m_pad] -> a padded
@@ -696,33 +697,64 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
 
             compact = (totals_acc, fills_acc, cancels_acc)
             if len(_cap_ladder(eng.config.cap)) > 1:
-                # The count_ub re-anchor rides the frame's single fetch —
+                # The count_ub re-anchor rides the frame's totals fetch —
                 # but only multi-class engines ever read it; single-class
                 # ones skip the [S]-wide reduction and transfer.
                 compact += (jnp.max(books.count, axis=-1),)
-            for leaf in compact:
-                leaf.copy_to_host_async()
+            # Phase-1 fetch starts now: totals (+counts_max) are tiny and
+            # resolve needs them FIRST — the event matrices are fetched
+            # as used-prefix slices sized from the totals (resolve_frame),
+            # so the transfer scales with the frame's EVENTS, not with
+            # the pow2-margined buffer capacity (7-8x the events on a
+            # margined mixed flow; the delta is wall on a PCIe host but
+            # wall AND deserialize CPU on a tunneled link).
+            compact[0].copy_to_host_async()
+            if len(compact) > 3:
+                compact[3].copy_to_host_async()
         return PendingFrame(cols, a, cp, items, compact, n_kept)
     except Exception:
         eng._restore(cp)
         raise
 
 
+@functools.lru_cache(maxsize=256)
+def _prefix_slice_fn(n_fields: int, length: int):
+    """Jitted used-prefix slice [F, e] -> [F, length]: phase 2 of the
+    two-phase frame fetch transfers only the events that exist, not the
+    pow2-margined buffer capacity. length is pow2-bucketed by the caller
+    so the compiled-shape set stays logarithmic."""
+
+    @jax.jit
+    def take(mat):
+        return jax.lax.slice(mat, (0, 0), (n_fields, length))
+
+    return take
+
+
 def resolve_frame(eng: BatchEngine, pend: PendingFrame):
-    """Fetch + decode a submitted frame (ONE device->host fetch of the
-    frame-level event buffers). Raises _NeedExact when a device budget
-    tripped — the CALLER owns the recovery (rewind to pend.checkpoint,
-    exact-run, resubmit anything submitted after); the single-frame
-    wrapper apply_frame_fast and the pipelined executor
-    (engine.pipeline.FramePipeline) both do."""
+    """Fetch + decode a submitted frame — TWO-phase device->host fetch:
+
+      1. the [G, 4] totals (+ the [S] count_ub re-anchor), tiny and
+         already in flight since submit;
+      2. the USED PREFIX of the fill/cancel event matrices, pow2-bucketed
+         from the totals — a margined mixed-flow buffer is 7-8x its
+         actual events, and on a tunneled dev link that delta is seconds
+         of wall AND deserialize CPU per frame (PCIe: microseconds).
+
+    Raises _NeedExact when a device budget tripped — the CALLER owns the
+    recovery (rewind to pend.checkpoint, exact-run, resubmit anything
+    submitted after); the single-frame wrapper apply_frame_fast and the
+    pipelined executor (engine.pipeline.FramePipeline) both do."""
     if pend.compact is None:
         return _assemble(eng, pend.arrays, [])
     global FETCH_SECONDS
     t0 = time.perf_counter()
-    fetched = jax.device_get(pend.compact)
+    totals_dev, fills_dev, cancels_dev = pend.compact[:3]
+    totals = jax.device_get(totals_dev)
+    counts_max = (
+        jax.device_get(pend.compact[3]) if len(pend.compact) > 3 else None
+    )
     FETCH_SECONDS += time.perf_counter() - t0
-    totals, fills_mat, cancels_mat = fetched[:3]
-    counts_max = fetched[3] if len(fetched) > 3 else None
     g = len(pend.items)
     nf_g = totals[:g, 0].astype(np.int64)
     nc_g = totals[:g, 1].astype(np.int64)
@@ -734,7 +766,7 @@ def resolve_frame(eng: BatchEngine, pend: PendingFrame):
     # totals are TRUE counts (appends past the buffer drop but the mask
     # sums fully), so one step reaches the right size.
     tripped = False
-    if total_f > fills_mat.shape[1]:
+    if total_f > fills_dev.shape[1]:
         cls = eng._buf_class(pend.n_kept)
         eng._fills_buf_floor[cls] = max(
             eng._fills_buf_floor.get(cls, 0), _next_pow2(total_f)
@@ -751,9 +783,21 @@ def resolve_frame(eng: BatchEngine, pend: PendingFrame):
         )
         # Unreachable by construction (cancels <= the frame's DEL count,
         # which sizes the buffer) — defensive only.
-        or total_c > cancels_mat.shape[1]
+        or total_c > cancels_dev.shape[1]
     ):
         raise _NeedExact()
+    # Phase 2: fetch the used prefixes (pow2-bucketed, clamped to the
+    # buffer) now the true counts are known.
+    t0 = time.perf_counter()
+    f_len = min(_next_pow2(max(total_f, 64)), int(fills_dev.shape[1]))
+    c_len = min(_next_pow2(max(total_c, 64)), int(cancels_dev.shape[1]))
+    fills_mat = jax.device_get(
+        _prefix_slice_fn(int(fills_dev.shape[0]), f_len)(fills_dev)
+    )
+    cancels_mat = jax.device_get(
+        _prefix_slice_fn(int(cancels_dev.shape[0]), c_len)(cancels_dev)
+    )
+    FETCH_SECONDS += time.perf_counter() - t0
     # Re-anchor count_ub from this frame's true post-frame counts (the
     # pipeline resolves FIFO, so extra minus THIS frame's increments is
     # exactly the still-in-flight sum; a trip above skips this and the
@@ -864,11 +908,17 @@ def precompile_combos(eng: BatchEngine, combos) -> int:
     wide = jnp.result_type(jnp.int32, eng.config.dtype)
     dt = np.dtype(eng.config.dtype)
     combos = sorted(set(map(tuple, combos)))
+    replayed = 0
     for combo in combos:
         (
             n_rows, t_grid, cap_g, dense, m_pad, k_rec,
             e_fills, e_cancels, totals_len,
         ) = combo
+        if cap_g > eng.config.cap:
+            # Recorded after a storage-cap escalation this engine hasn't
+            # done (caller can eng.ensure_cap() first — load_geometry
+            # does). Unreplayable as-is; skip rather than crash.
+            continue
         cols = np.zeros((7, m_pad), dt)
         flat = np.full(m_pad, n_rows * t_grid, np.int32)
         ops = _scatter_grid_fn(dt.name, n_rows, t_grid)(cols, flat)
@@ -887,12 +937,32 @@ def precompile_combos(eng: BatchEngine, combos) -> int:
         # blocking frees it before the next combo allocates.
         jax.block_until_ready(out)
         eng._seen_combos.add(combo)
+        replayed += 1
     from .batch import _cap_ladder
 
     if len(_cap_ladder(eng.config.cap)) > 1:
         # The count_ub re-anchor reduction that rides every frame fetch.
         jax.block_until_ready(jnp.max(eng.books.count, axis=-1))
-    return len(combos)
+    # Phase-2 prefix-slice kernels (resolve_frame): warm the plausible
+    # pow2 lengths for every recorded buffer size so a boundary-crossing
+    # event count never compiles mid-traffic. Tiny graphs, but a compile
+    # is a compile.
+    wide_zeros = {}
+    for combo in combos:
+        for n_fields, e in (
+            (len(_FILL_FIELDS), combo[6]),
+            (len(_CANCEL_FIELDS), combo[7]),
+        ):
+            key = (n_fields, e)
+            if key not in wide_zeros:
+                wide_zeros[key] = jnp.zeros((n_fields, e), wide)
+            length = e
+            while length >= 64:
+                jax.block_until_ready(
+                    _prefix_slice_fn(n_fields, length)(wide_zeros[key])
+                )
+                length //= 2
+    return replayed
 
 
 class _NeedExact(Exception):

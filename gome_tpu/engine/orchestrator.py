@@ -273,7 +273,9 @@ class MatchEngine:
             json.dump(m, f)
         os.replace(tmp, path)  # atomic: readers never see a torn file
 
-    def load_geometry(self, path: str, precompile: bool = True) -> int:
+    def load_geometry(
+        self, path: str, precompile: bool = True, presize_cap: bool = True
+    ) -> int:
         """Load a persisted shape manifest: prewarm the grow-only floors
         (so this process CHOOSES the recorded shapes) and, by default,
         replay the recorded combos with all-padding inputs (so they are
@@ -286,11 +288,29 @@ class MatchEngine:
         from . import frames
 
         try:
-            with open(path) as f:
+            try:
+                f = open(path)
+            except FileNotFoundError:
+                return 0  # no manifest yet: the normal first-boot case
+            with f:
                 m = json.load(f)
             floors = m["floors"]
             combos = m["combos"]
             as_int = lambda d: {int(k): int(v) for k, v in d.items()}
+            # Pre-size storage to the flow's recorded stationary cap:
+            # boots pay ONE up-front grow instead of a mid-traffic
+            # escalate+replay, and the deep-cap combos become replayable.
+            # presize_cap=False keeps boot storage (shallow flows through
+            # the same engine then run at their own cheaper cap; combos
+            # above it are skipped and compile from the persistent cache
+            # when escalation genuinely happens).
+            if presize_cap and floors.get("cap"):
+                # Clamp to this engine's max_cap: a manifest from a
+                # bigger deployment must degrade (shallower presize,
+                # deep combos skipped), never abort the whole load.
+                self.batch.ensure_cap(
+                    min(int(floors["cap"]), self.batch.max_cap)
+                )
             self.batch.prewarm_geometry(
                 rows_floor=as_int(floors.get("rows_floor", {})),
                 t_floor=as_int(floors.get("t_floor", {})),
@@ -301,12 +321,19 @@ class MatchEngine:
                 self.batch._seen_combos |= set(map(tuple, combos))
                 return 0
             return frames.precompile_combos(self.batch, combos)
-        except Exception:
+        except Exception as e:
             # Best-effort end to end: a stale manifest (combo layout from
             # an older version, shapes recorded before an n_slots growth)
             # must never stop a boot — it is a performance hint, never
             # state. Whatever floors merged before the failure stand
-            # (grow-only, still valid).
+            # (grow-only, still valid). But never SILENTLY: a swallowed
+            # failure here cost two full bench rounds of mid-region
+            # compiles before anyone noticed.
+            from ..utils.logging import get_logger
+
+            get_logger("engine").warning(
+                "geometry manifest %s not applied: %s", path, e
+            )
             return 0
 
     @staticmethod

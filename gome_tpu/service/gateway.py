@@ -87,18 +87,24 @@ class OrderGateway:
         else:
             self._bus.order_queue.publish(encode_order(order))
 
+    def _validate_add(self, request: pb.OrderRequest) -> Order:
+        """OrderRequest -> admitted ADD Order; raises ValueError with the
+        edge-rejection reason (code 3) otherwise."""
+        order = order_from_request(request, Action.ADD, self._accuracy)
+        if order.volume <= 0:
+            raise ValueError("volume must be positive")
+        if self._max_volume is not None and order.volume > self._max_volume:
+            raise ValueError(
+                f"volume {order.volume} exceeds the engine's per-order "
+                f"lot ceiling {self._max_volume}"
+            )
+        if order.order_type is OrderType.LIMIT and order.price <= 0:
+            raise ValueError("limit price must be positive")
+        return order
+
     def DoOrder(self, request: pb.OrderRequest, context) -> pb.OrderResponse:
         try:
-            order = order_from_request(request, Action.ADD, self._accuracy)
-            if order.volume <= 0:
-                raise ValueError("volume must be positive")
-            if self._max_volume is not None and order.volume > self._max_volume:
-                raise ValueError(
-                    f"volume {order.volume} exceeds the engine's per-order "
-                    f"lot ceiling {self._max_volume}"
-                )
-            if order.order_type is OrderType.LIMIT and order.price <= 0:
-                raise ValueError("limit price must be positive")
+            order = self._validate_add(request)
         except ValueError as e:
             return pb.OrderResponse(code=3, message=f"rejected: {e}")
         self._mark(order)  # pre-pool before queueing (main.go:44-45)
@@ -129,6 +135,80 @@ class OrderGateway:
             # Batcher closed or bus down: reject, don't crash the handler.
             return pb.OrderResponse(code=3, message=f"rejected: {e}")
         return pb.OrderResponse(code=0, message="cancel accepted")
+
+    def _apply_entries(self, entries) -> pb.OrderBatchResponse:
+        """Shared core of the amortized-ingest RPCs: apply (request,
+        is_cancel) pairs in order — per-entry validation rejects are
+        collected (parallel reject_index/rejects arrays), accepted
+        entries mark + emit exactly like their unary counterparts. An
+        emit failure (batcher closed / bus down) stops the batch: the
+        response carries code 3 and `accepted` says how many entries
+        made it into the pipeline before the failure (at-most-once for
+        the remainder — the client resubmits them)."""
+        resp = pb.OrderBatchResponse()
+        accepted = 0
+        for i, (request, is_cancel) in enumerate(entries):
+            if is_cancel:
+                try:
+                    order = order_from_request(
+                        request, Action.DEL, self._accuracy
+                    )
+                except ValueError as e:
+                    resp.reject_index.append(i)
+                    resp.rejects.add(code=3, message=f"rejected: {e}")
+                    continue
+                try:
+                    self._emit(order)
+                except (RuntimeError, ConnectionError, OSError) as e:
+                    resp.code = 3
+                    resp.message = f"batch aborted at entry {i}: {e}"
+                    break
+            else:
+                try:
+                    order = self._validate_add(request)
+                except ValueError as e:
+                    resp.reject_index.append(i)
+                    resp.rejects.add(code=3, message=f"rejected: {e}")
+                    continue
+                self._mark(order)
+                try:
+                    self._emit(order)
+                except (RuntimeError, ConnectionError, OSError) as e:
+                    self._unmark(order)
+                    resp.code = 3
+                    resp.message = f"batch aborted at entry {i}: {e}"
+                    break
+            accepted += 1
+        resp.accepted = accepted
+        return resp
+
+    def DoOrderBatch(
+        self, request: pb.OrderBatchRequest, context
+    ) -> pb.OrderBatchResponse:
+        """Amortized ingest: many reference-shaped OrderRequests in one
+        RPC, applied in list order (same-batch ADD->DEL sequencing is
+        preserved; `cancel[i]` selects DeleteOrder semantics)."""
+        n = len(request.orders)
+        if request.cancel and len(request.cancel) != n:
+            return pb.OrderBatchResponse(
+                code=3,
+                message=(
+                    f"cancel mask length {len(request.cancel)} != "
+                    f"orders length {n}"
+                ),
+            )
+        cancels = request.cancel or (False,) * n
+        return self._apply_entries(zip(request.orders, cancels))
+
+    def DoOrderStream(
+        self, request_iterator, context
+    ) -> pb.OrderBatchResponse:
+        """Client-streaming ingest: ADD semantics per message (cancels go
+        through DeleteOrder / DoOrderBatch); one summary response when
+        the client half-closes."""
+        return self._apply_entries(
+            (request, False) for request in request_iterator
+        )
 
     def SubscribeMatches(self, request: pb.SubscribeRequest, context):
         if self._match_feed is None:

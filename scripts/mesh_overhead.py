@@ -1,0 +1,159 @@
+"""Measure what the mesh costs (VERDICT r4 #5) — two parts:
+
+Part A (runs wherever JAX runs; meaningful on the REAL chip): mesh=1
+shard_map dispatch overhead. The same full grid at the headline service
+shape (10240 lanes x 32 t, int32, pallas) steps through (a) the unsharded
+engine path and (b) `sharded_batch_step` over a 1-device mesh — same
+kernel, same bytes, the delta is what shard_map + sharding constraints
+add per dispatch. Dense variant included (the Zipf hot path).
+
+Part B (host-side analysis, no device needed): per-shard row-padding
+overhead under Zipf skew. The dense packer buckets each shard's row block
+to the MAX per-shard live count (engine/batch.py _grid_geometry), so skew
+concentrates rows on one shard and every other shard pads to match. For
+D in {1,2,4,8} over the service bench's own Zipf flow: dispatched-rows /
+live-lanes ratio (p50/p95) — the true multi-chip tax of the dense win.
+
+Usage:
+    python scripts/mesh_overhead.py            # Part A on default backend
+    python scripts/mesh_overhead.py --skew     # Part B (host only)
+Output: one JSON line per part (stored in ARCHITECTURE.md's table).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def part_a():
+    import jax
+    import jax.numpy as jnp
+
+    from gome_tpu.engine import BatchEngine, BookConfig, init_books
+    from gome_tpu.engine.book import DeviceOp
+    from gome_tpu.parallel import make_mesh, shard_batch, sharded_batch_step
+    from gome_tpu.parallel.mesh import sharded_dense_step
+
+    S = int(os.environ.get("MESH_SYMBOLS", 10_240))
+    T = int(os.environ.get("MESH_T", 32))
+    CAP = int(os.environ.get("MESH_CAP", 256))
+    REPS = int(os.environ.get("MESH_REPS", 30))
+    config = BookConfig(cap=CAP, max_fills=16, dtype=jnp.int32)
+
+    rng = np.random.default_rng(3)
+    n_ops = S * T
+
+    def mk_grid(rows):
+        f = {}
+        shape = (rows, T)
+        f["action"] = rng.integers(1, 2, shape)  # all ADDs
+        f["side"] = rng.integers(0, 2, shape)
+        f["is_market"] = np.zeros(shape, np.int64)
+        f["price"] = rng.integers(90, 110, shape)
+        f["volume"] = rng.integers(1, 50, shape)
+        f["oid"] = np.arange(rows * T).reshape(shape) + 1
+        f["uid"] = np.ones(shape, np.int64)
+        from gome_tpu.engine.book import GRID_I32_FIELDS
+
+        return DeviceOp(**{
+            k: np.asarray(
+                v, np.int32 if k in GRID_I32_FIELDS else config.dtype
+            )
+            for k, v in f.items()
+        })
+
+    ops = mk_grid(S)
+
+    def time_step(fn, *args):
+        out = fn(*args)  # compile
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / REPS
+
+    results = {}
+
+    # Unsharded full-grid pallas step (the single-chip headline path).
+    eng = BatchEngine(config, n_slots=S, max_t=T, kernel="pallas")
+    t_unsharded = time_step(lambda o: eng._step(eng.books, o), ops)
+    results["full_unsharded_ms"] = round(t_unsharded * 1e3, 3)
+
+    # mesh=1: the same step through shard_map + pinned shardings.
+    mesh = make_mesh(1)
+    stepper = sharded_batch_step(config, mesh, kernel="pallas")
+    books_m = shard_batch(mesh, init_books(config, S))
+    ops_m = shard_batch(mesh, ops)
+    t_mesh1 = time_step(lambda b, o: stepper(b, o), books_m, ops_m)
+    results["full_mesh1_ms"] = round(t_mesh1 * 1e3, 3)
+    results["full_mesh1_overhead_pct"] = round(
+        (t_mesh1 / t_unsharded - 1) * 100, 1
+    )
+
+    # Dense variant: 1024 live lanes of the 10240 (Zipf-ish live set).
+    R = 1024
+    dense_ops = mk_grid(R)
+    lane_ids = np.arange(R, dtype=np.int32)
+    eng2 = BatchEngine(config, n_slots=S, max_t=T, kernel="pallas")
+    t_dense = time_step(
+        lambda o: eng2._step(eng2.books, o, lane_ids), dense_ops
+    )
+    results["dense_unsharded_ms"] = round(t_dense * 1e3, 3)
+    dstepper = sharded_dense_step(config, mesh, kernel="pallas")
+    books2 = shard_batch(mesh, init_books(config, S))
+    ids_m = shard_batch(mesh, np.asarray(lane_ids, np.int32))
+    dops_m = shard_batch(mesh, dense_ops)
+    t_dense_m = time_step(
+        lambda b, i, o: dstepper(b, i, o), books2, ids_m, dops_m
+    )
+    results["dense_mesh1_ms"] = round(t_dense_m * 1e3, 3)
+    results["dense_mesh1_overhead_pct"] = round(
+        (t_dense_m / t_dense - 1) * 100, 1
+    )
+    results["orders_per_step"] = n_ops
+    results["platform"] = jax.devices()[0].platform
+    print(json.dumps({"mesh_overhead_mesh1": results}))
+
+
+def part_b():
+    """Row-padding overhead of per-shard max bucketing under Zipf skew —
+    pure host analysis of the packer's own math (_grid_geometry)."""
+    from gome_tpu.engine.batch import _next_pow2
+
+    S = int(os.environ.get("MESH_SYMBOLS", 10_240))
+    FRAMES = 64
+    rng = np.random.default_rng(11)
+    # The service bench's Zipf shape: symbol ~ Zipf(1.2) capped to S.
+    rows = {}
+    for d in (1, 2, 4, 8):
+        ratios = []
+        local = S // d
+        for _ in range(FRAMES):
+            syms = rng.zipf(1.2, size=8192) % S
+            live = np.unique(syms)
+            shard = live // local
+            counts = np.bincount(shard, minlength=d)
+            r_s = max(8, _next_pow2(int(counts.max())))
+            dispatched = r_s * d
+            ratios.append(dispatched / len(live))
+        ratios = np.asarray(ratios)
+        rows[f"D{d}"] = dict(
+            p50_rows_per_live_lane=round(float(np.median(ratios)), 2),
+            p95_rows_per_live_lane=round(
+                float(np.percentile(ratios, 95)), 2
+            ),
+        )
+    print(json.dumps({"mesh_dense_row_padding_zipf": rows}))
+
+
+if __name__ == "__main__":
+    if "--skew" in sys.argv:
+        part_b()
+    else:
+        part_a()

@@ -48,6 +48,17 @@ engine = MatchEngine(
     n_slots=S, max_t=32, kernel="pallas",
     dense_t_max=int(os.environ.get("SVC_DENSE_T", 8192)),
 )
+# Load the service bench's persisted geometry manifest (same default
+# path) so the profile sees the converged shapes, not trace/compile noise.
+geom = os.environ.get(
+    "SVC_GEOMETRY",
+    os.path.join(
+        os.environ.get("GOME_JAX_CACHE", "/root/.cache/gome_jax"),
+        f"svc_geometry_S{S}_C{CAP}_F{FRAME}.json",
+    ),
+)
+n_pre = engine.load_geometry(geom)
+print(f"precompiled {n_pre} combos from {geom}", file=sys.stderr)
 bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
 consumer = OrderConsumer(
     engine, bus, batch_n=1, batch_wait_s=0, match_wire="frame",
@@ -70,7 +81,9 @@ else:
         oid_box[0] += FRAME
         return cols
 
-n_warm = _svc_warmup(engine, consumer, bus, make_frame, symbols)
+n_warm = _svc_warmup(
+    engine, consumer, bus, make_frame, symbols, margin=n_pre == 0
+)
 print(f"warm_frames={n_warm}", file=sys.stderr)
 
 frames_cols = [make_frame() for _ in range(-(-N // FRAME))]
