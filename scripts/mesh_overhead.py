@@ -42,7 +42,7 @@ def part_a():
     S = int(os.environ.get("MESH_SYMBOLS", 10_240))
     T = int(os.environ.get("MESH_T", 32))
     CAP = int(os.environ.get("MESH_CAP", 256))
-    REPS = int(os.environ.get("MESH_REPS", 30))
+    REPS = int(os.environ.get("MESH_REPS", 100))
     config = BookConfig(cap=CAP, max_fills=16, dtype=jnp.int32)
 
     rng = np.random.default_rng(3)
@@ -69,20 +69,42 @@ def part_a():
 
     ops = mk_grid(S)
 
-    def time_step(fn, *args):
-        out = fn(*args)  # compile
-        jax.block_until_ready(out)
+    def sync(tree):
+        """Force completion with a value fetch: block_until_ready on a
+        sharded array over the tunneled backend returns before execution
+        (observed: 30 chained full steps 'completing' in 2ms), so the
+        probe syncs by materializing a scalar that depends on the
+        result."""
+        leaf = jax.tree.leaves(tree)[0]
+        np.asarray(jax.device_get(leaf.sum()))
+
+    def time_step(fn, books0, *args):
+        """Thread the books output back in each iteration: steps must
+        form a true serial chain (independent calls let the device/link
+        pipeline them and the per-step time reads fictitiously low). The
+        closing sync's own tunnel RTT (a flat ~0.1-1s on this link) is
+        measured separately and subtracted so it does not smear a
+        constant into every per-step time."""
+        books, out = fn(books0, *args)  # compile
+        sync(out)
+        t0 = time.perf_counter()
+        sync(books0)
+        t_sync = time.perf_counter() - t0
+        books = books0
         t0 = time.perf_counter()
         for _ in range(REPS):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / REPS
+            books, out = fn(books, *args)
+        sync(books)
+        return max(time.perf_counter() - t0 - t_sync, 1e-9) / REPS
 
     results = {}
 
     # Unsharded full-grid pallas step (the single-chip headline path).
+    # device_put the grids up front for BOTH paths: numpy inputs would
+    # re-upload ~10MB per call over the dev tunnel and measure the link.
     eng = BatchEngine(config, n_slots=S, max_t=T, kernel="pallas")
-    t_unsharded = time_step(lambda o: eng._step(eng.books, o), ops)
+    ops = jax.device_put(ops)
+    t_unsharded = time_step(lambda b, o: eng._step(b, o), eng.books, ops)
     results["full_unsharded_ms"] = round(t_unsharded * 1e3, 3)
 
     # mesh=1: the same step through shard_map + pinned shardings.
@@ -98,11 +120,11 @@ def part_a():
 
     # Dense variant: 1024 live lanes of the 10240 (Zipf-ish live set).
     R = 1024
-    dense_ops = mk_grid(R)
-    lane_ids = np.arange(R, dtype=np.int32)
+    dense_ops = jax.device_put(mk_grid(R))
+    lane_ids = jax.device_put(np.arange(R, dtype=np.int32))
     eng2 = BatchEngine(config, n_slots=S, max_t=T, kernel="pallas")
     t_dense = time_step(
-        lambda o: eng2._step(eng2.books, o, lane_ids), dense_ops
+        lambda b, o: eng2._step(b, o, lane_ids), eng2.books, dense_ops
     )
     results["dense_unsharded_ms"] = round(t_dense * 1e3, 3)
     dstepper = sharded_dense_step(config, mesh, kernel="pallas")

@@ -1,0 +1,72 @@
+"""Measure the marker-store admission path at frame rate (VERDICT r4 #6).
+
+The production single-binary topology keeps pre-pool markers IN-PROCESS
+(the C++ open-addressing pool, engine/prepool.NativePrePool) — the
+gateway marks on accept, the consumer consumes at admission, no network
+hop. This probe times both halves on real frame-shaped columns (the
+service bench's own mixed-flow shape: dictionary-encoded symbols/uuids,
+fresh oids, ~45% DELs) and prints one JSON line of orders/sec/core —
+the number that must clear a 0.5M/s shard's admission budget.
+
+For SPLIT deployments (gateway and consumer in different processes) the
+markers live in a RESP server instead; that slower path is measured
+separately by the service bench's marker_server section and is not the
+production shard topology.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import bench
+from gome_tpu.engine.prepool import NativePrePool, make_prepool
+
+N = int(os.environ.get("PREPOOL_ORDERS", 262_144))
+FRAMES = int(os.environ.get("PREPOOL_FRAMES", 8))
+S = 10_240
+
+flow = bench._MixedFlow(np.random.default_rng(11), S)
+symbols = [f"sym{i}" for i in range(S)]
+frames = [
+    dict(flow.frame(N), symbols=symbols, uuids=bench._SVC_UUIDS)
+    for _ in range(FRAMES)
+]
+
+pool = make_prepool()
+native = isinstance(pool, NativePrePool)
+if not hasattr(pool, "mark_frame"):
+    # No native pool on this host (no C++ toolchain): the probe measures
+    # the production admission path, which is the native pool — report
+    # and exit instead of crashing on the bare-set fallback.
+    print(json.dumps({"backend": "unavailable (no native prepool)"}))
+    sys.exit(0)
+
+# Warm (hash growth, interning) off the clock.
+pool.mark_frame(frames[0])
+pool.consume_frame(frames[0])
+
+t0 = time.process_time()
+for cols in frames:
+    pool.mark_frame(cols)
+mark_cpu = time.process_time() - t0
+
+t0 = time.process_time()
+total = 0
+for cols in frames:
+    keep, consumed = pool.consume_frame(cols)
+    total += int(cols["n"])
+consume_cpu = time.process_time() - t0
+
+result = {
+    "metric": "in-process pre-pool admission (mixed-flow frames, "
+    f"{N}-order, {S} symbols)",
+    "backend": "native-cc" if native else "python-set",
+    "mark_orders_per_sec_per_core": round(N * FRAMES / max(mark_cpu, 1e-9)),
+    "consume_orders_per_sec_per_core": round(total / max(consume_cpu, 1e-9)),
+}
+print(json.dumps(result))
