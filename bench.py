@@ -529,8 +529,11 @@ def _svc_warmup(engine, consumer, bus, make_frame, symbols, margin=True):
     # cap class exactly once, latching e.g. a 1024-row x 1024-deep grid
     # floor that steady state never needs — seconds of device time per
     # frame, forever). Reset, let two steady-state frames re-ratchet
-    # honest geometry, then pin the margin on THAT.
-    engine.batch.reset_geometry_floors()
+    # honest geometry, then pin the margin on THAT. The recorded shape
+    # COMBOS from the transient frames are forgotten with the floors:
+    # save_geometry would otherwise persist them and every later boot
+    # would precompile deep-grid shapes the steady-state flow never uses.
+    engine.batch.reset_geometry_floors(combos=True)
     for _ in range(2):
         _svc_gateway_step(
             make_frame(), symbols, engine.pre_pool, bus.order_queue
@@ -603,6 +606,11 @@ def service_main():
         pipeline_depth=PIPE,
     )
 
+    # Clamp BEFORE the manifest key is built: a small-N run records
+    # different frame-shape combos than a full-size run, so they must not
+    # share one manifest file (keying on the pre-clamp FRAME did).
+    FRAME = min(FRAME, N)
+
     # Persisted geometry (shape manifest): like a production deployment,
     # the service loads the flow's recorded floors + shape combos from the
     # previous run and precompiles them off-clock — the timed region then
@@ -635,7 +643,6 @@ def service_main():
 
     rng = np.random.default_rng(7)
     symbols = [f"sym{i}" for i in range(S)]
-    FRAME = min(FRAME, N)
 
     from gome_tpu.bus.colwire import decode_event_frame
 

@@ -94,10 +94,14 @@ def make_bus(config) -> QueueBus:
                 name, os.path.join(config.dir, name)
             )
     elif config.backend == "amqp":
-        from .amqp import AmqpQueue
+        # Supervised client: reconnect with backoff + circuit breaker +
+        # topology re-declare on every ConnectionError (utils.resilience).
+        # The raw AmqpQueue fails loudly and stays down; the supervised
+        # wrapper is what makes a broker bounce a non-event.
+        from .amqp import SupervisedAmqpQueue
 
         def factory(name, _cfg=config):
-            return AmqpQueue(
+            return SupervisedAmqpQueue(
                 name,
                 host=_cfg.host,
                 port=_cfg.port,

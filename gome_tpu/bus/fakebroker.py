@@ -40,7 +40,11 @@ from .amqp import (
 class _BrokerQueue:
     def __init__(self, name: str):
         self.name = name
-        self.pending: deque[bytes] = deque()
+        # (body, redelivered): the redelivered flag rides Basic.Deliver so
+        # a reconnecting consumer can tell replayed deliveries from fresh
+        # ones (RabbitMQ semantics; bus.amqp.SupervisedAmqpQueue keys its
+        # exact-resume dedup on it).
+        self.pending: deque[tuple[bytes, bool]] = deque()
         self.consumers: list["_Connection"] = []  # round-robin order
         self.drain_lock = threading.Lock()  # one drainer at a time (FIFO)
         self._rr = 0
@@ -67,12 +71,14 @@ class _Connection:
         self._next_tag = 1
         self._pending_pub: tuple | None = None  # (queue, bytearray, [size])
         self._publishes = 0  # fault-mode accounting
+        self._confirm = False  # publisher-confirm mode (Confirm.Select)
+        self._pub_tag = 0  # confirm-mode ack tag sequence
 
     def send(self, data: bytes) -> None:
         with self.wlock:
             self.sock.sendall(data)
 
-    def deliver(self, queue: str, body: bytes) -> None:
+    def deliver(self, queue: str, body: bytes, redelivered: bool = False) -> None:
         # Broker threads for DIFFERENT producer connections can deliver to
         # the same consumer concurrently: tag allocation + unacked insert +
         # the send must be one atomic unit or tags duplicate and unacked
@@ -86,7 +92,7 @@ class _Connection:
                 60,
                 60,
                 shortstr(f"c-{queue}")
-                + struct.pack(">QB", tag, 0)
+                + struct.pack(">QB", tag, 1 if redelivered else 0)
                 + shortstr("")
                 + shortstr(queue),
             )
@@ -187,6 +193,12 @@ class _Connection:
             if self._publishes == self.broker.close_abruptly_on_publish:
                 # Fault mode: the broker process dies mid-stream — no
                 # Close method, just a dead socket (kill -9 equivalent).
+                # shutdown first so the peer SEES the death immediately
+                # (close alone leaves its blocked reader hanging).
+                try:
+                    self.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
                 self.sock.close()
                 self.closed = True
                 return
@@ -225,12 +237,26 @@ class _Connection:
                         self.unacked.pop(t, None)
                 else:
                     self.unacked.pop(tag, None)
+        elif (class_id, method_id) == (85, 10):  # Confirm.Select
+            self._confirm = True
+            self.send(frame(FRAME_METHOD, channel, method(85, 11)))
         # anything else: ignore (permissive test broker)
 
     def _finish_publish(self) -> None:
         qname, body, _ = self._pending_pub
         self._pending_pub = None
         self.broker._publish(qname, bytes(body))
+        if self._confirm:
+            # Publisher confirm: Basic.Ack AFTER the enqueue — a killed
+            # connection whose publish was dropped never acks, which is
+            # what lets a supervised publisher retry exactly.
+            self._pub_tag += 1
+            self.send(
+                frame(
+                    FRAME_METHOD, 1,
+                    method(60, 80, struct.pack(">QB", self._pub_tag, 0)),
+                )
+            )
 
     def _heartbeat_loop(self) -> None:
         hb = frame(8, 0, b"")  # FRAME_HEARTBEAT
@@ -301,8 +327,20 @@ class FakeBroker:
                 self._server.close()
             except OSError:
                 pass
+            # Wake the accept thread (a blocked accept() keeps the LISTEN
+            # socket's file description open — the port would linger).
+            try:
+                socket.create_connection(
+                    (self.host, self.port), timeout=0.2
+                ).close()
+            except OSError:
+                pass
         for c in list(self._conns):
             c.closed = True
+            try:
+                c.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 c.sock.close()
             except OSError:
@@ -330,7 +368,7 @@ class FakeBroker:
     def _publish(self, name: str, body: bytes) -> None:
         q = self._queue(name)
         with self._lock:
-            q.pending.append(body)
+            q.pending.append((body, False))
         self._drain(q)
 
     def _attach_consumer(self, name: str, conn: _Connection) -> None:
@@ -354,22 +392,66 @@ class FakeBroker:
                     consumer = q.next_consumer()
                     if consumer is None:
                         return
-                    body = q.pending.popleft()
+                    body, redelivered = q.pending.popleft()
                 try:
-                    consumer.deliver(q.name, body)
+                    consumer.deliver(q.name, body, redelivered)
                 except OSError:
                     with self._lock:
-                        q.pending.appendleft(body)
+                        q.pending.appendleft((body, redelivered))
                     return
 
     def _requeue_unacked(self, conn: _Connection) -> None:
         """Connection died: everything it held unacked goes back to its
-        queue (FIFO by delivery tag) — RabbitMQ's at-least-once redelivery."""
+        queue at the HEAD (FIFO by delivery tag, AHEAD of messages
+        published during the outage) — RabbitMQ's at-least-once
+        redelivery, which replays requeued messages before younger ones.
+        Head placement is what lets a reconnecting consumer rebuild the
+        exact arrival order it saw before the drop (bus.amqp.
+        SupervisedAmqpQueue relies on it)."""
         with conn.dlock:
             items = sorted(conn.unacked.items())
             conn.unacked.clear()
+        by_queue: dict[str, list[bytes]] = {}
         for _tag, (qname, body) in items:
-            self._publish(qname, body)
+            by_queue.setdefault(qname, []).append(body)
+        for qname, bodies in by_queue.items():
+            q = self._queue(qname)
+            with self._lock:
+                q.pending.extendleft(
+                    (body, True) for body in reversed(bodies)
+                )
+            self._drain(q)
+
+    def kill_connections(self, consuming: str | None = None) -> int:
+        """Fault injection: abruptly close live connections (no Close
+        handshake — kill -9 / network-partition equivalent). With
+        `consuming` set, only connections consuming that queue die (the
+        broker-side way to kill a specific consumer mid-stream). Unacked
+        deliveries requeue via each connection's normal death path.
+        Returns the number of connections killed.
+
+        shutdown() before close(): close() alone does NOT wake a thread
+        blocked in recv() on the same socket (neither our conn thread nor
+        the peer would notice for seconds), while shutdown sends the FIN
+        and interrupts both sides immediately — the kill must be
+        OBSERVABLE at the instant it happens for fault schedules to be
+        deterministic."""
+        killed = 0
+        for c in list(self._conns):
+            if c.closed:
+                continue
+            if consuming is not None and consuming not in c.consuming:
+                continue
+            try:
+                c.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+            killed += 1
+        return killed
 
     def queue_depth(self, name: str) -> int:
         """Test introspection: messages waiting with no consumer."""

@@ -713,7 +713,7 @@ class BatchEngine:
         if cancels_buf is not None:
             _merge_buf_floor(self._cancels_buf_floor, cancels_buf)
 
-    def reset_geometry_floors(self) -> None:
+    def reset_geometry_floors(self, combos: bool = False) -> None:
         """Forget every grow-only geometry ratchet (rows/depth floors,
         compaction-buffer floors). Correctness-neutral — floors are
         performance hints — but sometimes necessary for performance:
@@ -723,11 +723,18 @@ class BatchEngine:
         pathologically wide-and-deep grid for the life of the process. A
         warmup loop calls this once the flow reaches steady state, lets
         the next frames re-ratchet from honest geometry, and THEN pins
-        margins / saves the manifest."""
+        margins / saves the manifest.
+
+        combos=True also forgets the recorded shape combos: the transient
+        frames' shapes would otherwise ride save_geometry into the
+        manifest and every later boot would precompile grids the
+        steady-state flow never dispatches."""
         self._dense_rows_floor.clear()
         self._dense_t_floor.clear()
         self._fills_buf_floor.clear()
         self._cancels_buf_floor.clear()
+        if combos:
+            self._seen_combos.clear()
 
     def ensure_cap(self, cap: int) -> None:
         """Pre-size book storage to `cap` slots/side (pow2-snapped,

@@ -909,35 +909,53 @@ def precompile_combos(eng: BatchEngine, combos) -> int:
     dt = np.dtype(eng.config.dtype)
     combos = sorted(set(map(tuple, combos)))
     replayed = 0
+    failed = 0
     for combo in combos:
-        (
-            n_rows, t_grid, cap_g, dense, m_pad, k_rec,
-            e_fills, e_cancels, totals_len,
-        ) = combo
-        if cap_g > eng.config.cap:
-            # Recorded after a storage-cap escalation this engine hasn't
-            # done (caller can eng.ensure_cap() first — load_geometry
-            # does). Unreplayable as-is; skip rather than crash.
+        # Per-combo isolation: one stale manifest combo (wrong tuple arity
+        # from an older layout, a full-grid n_rows that no longer equals
+        # n_slots after growth) must not abort every remaining replayable
+        # combo — the documented best-effort contract holds at combo
+        # granularity, not manifest granularity.
+        try:
+            (
+                n_rows, t_grid, cap_g, dense, m_pad, k_rec,
+                e_fills, e_cancels, totals_len,
+            ) = combo
+            if cap_g > eng.config.cap:
+                # Recorded after a storage-cap escalation this engine
+                # hasn't done (caller can eng.ensure_cap() first —
+                # load_geometry does). Unreplayable as-is; skip rather
+                # than crash.
+                continue
+            cols = np.zeros((7, m_pad), dt)
+            flat = np.full(m_pad, n_rows * t_grid, np.int32)
+            ops = _scatter_grid_fn(dt.name, n_rows, t_grid)(cols, flat)
+            lane_ids = (
+                np.full(n_rows, eng.n_slots, np.int64) if dense else None
+            )
+            _books, outs = eng._step(eng.books, ops, lane_ids, cap_g)
+            fills_acc = jnp.zeros((len(_FILL_FIELDS), e_fills), wide)
+            cancels_acc = jnp.zeros((len(_CANCEL_FIELDS), e_cancels), wide)
+            totals_acc = jnp.zeros((totals_len, 4), jnp.int32)
+            out = compact_accum(
+                eng.config, outs, fills_acc, cancels_acc, totals_acc,
+                np.int32(0),
+            )
+            # Serialize: each replay holds a transient books-sized output;
+            # blocking frees it before the next combo allocates.
+            jax.block_until_ready(out)
+        except Exception:
+            failed += 1
             continue
-        cols = np.zeros((7, m_pad), dt)
-        flat = np.full(m_pad, n_rows * t_grid, np.int32)
-        ops = _scatter_grid_fn(dt.name, n_rows, t_grid)(cols, flat)
-        lane_ids = (
-            np.full(n_rows, eng.n_slots, np.int64) if dense else None
-        )
-        _books, outs = eng._step(eng.books, ops, lane_ids, cap_g)
-        fills_acc = jnp.zeros((len(_FILL_FIELDS), e_fills), wide)
-        cancels_acc = jnp.zeros((len(_CANCEL_FIELDS), e_cancels), wide)
-        totals_acc = jnp.zeros((totals_len, 4), jnp.int32)
-        out = compact_accum(
-            eng.config, outs, fills_acc, cancels_acc, totals_acc,
-            np.int32(0),
-        )
-        # Serialize: each replay holds a transient books-sized output;
-        # blocking frees it before the next combo allocates.
-        jax.block_until_ready(out)
         eng._seen_combos.add(combo)
         replayed += 1
+    if failed:
+        from ..utils.logging import get_logger
+
+        get_logger("frames").warning(
+            "precompile_combos: %d stale combo(s) skipped, %d replayed",
+            failed, replayed,
+        )
     from .batch import _cap_ladder
 
     if len(_cap_ladder(eng.config.cap)) > 1:
@@ -949,19 +967,22 @@ def precompile_combos(eng: BatchEngine, combos) -> int:
     # is a compile.
     wide_zeros = {}
     for combo in combos:
-        for n_fields, e in (
-            (len(_FILL_FIELDS), combo[6]),
-            (len(_CANCEL_FIELDS), combo[7]),
-        ):
-            key = (n_fields, e)
-            if key not in wide_zeros:
-                wide_zeros[key] = jnp.zeros((n_fields, e), wide)
-            length = e
-            while length >= 64:
-                jax.block_until_ready(
-                    _prefix_slice_fn(n_fields, length)(wide_zeros[key])
-                )
-                length //= 2
+        try:  # same per-combo isolation as the replay loop above
+            for n_fields, e in (
+                (len(_FILL_FIELDS), combo[6]),
+                (len(_CANCEL_FIELDS), combo[7]),
+            ):
+                key = (n_fields, e)
+                if key not in wide_zeros:
+                    wide_zeros[key] = jnp.zeros((n_fields, e), wide)
+                length = e
+                while length >= 64:
+                    jax.block_until_ready(
+                        _prefix_slice_fn(n_fields, length)(wide_zeros[key])
+                    )
+                    length //= 2
+        except Exception:
+            continue
     return replayed
 
 

@@ -67,10 +67,22 @@ class RespPrePool:
     Implements enough of the set protocol for the engine's rollback
     (`pool |= consumed`), the persistence layer's snapshot (iteration) and
     restore (clear/update), plus the batched consume the admission hot
-    path uses."""
+    path uses.
+
+    With a persist.resp.SupervisedRespClient, a store restart mid-traffic
+    reconnects + retries under the hood: mark_frame/add/__ior__ (HSET) are
+    idempotent under retry; consume_batch (HDEL) inherits the lost-reply
+    ambiguity window every Redis deployment has (documented on the
+    client), which maps onto the consumer's at-least-once replay."""
 
     def __init__(self, client):
-        self.client = client  # persist.resp.RespClient (or redis-py)
+        self.client = client  # resp.RespClient / SupervisedRespClient / redis-py
+
+    def resilience(self) -> dict | None:
+        """The supervised client's state snapshot (breaker, reconnects,
+        time degraded) for health surfaces; None for a raw client."""
+        sup = getattr(self.client, "supervisor", None)
+        return sup().snapshot() if sup is not None else None
 
     # -- schema ------------------------------------------------------------
     @staticmethod

@@ -31,17 +31,24 @@ SYM_AXIS = "sym"
 
 
 def _shard_map_fn(mesh: Mesh):
-    """shard_map bound to `mesh` (check_vma off where supported:
-    pallas_call's ShapeDtypeStruct outputs carry no varying-mesh-axis
-    annotation, and the bodies here are embarrassingly parallel)."""
+    """shard_map bound to `mesh` (replication checking off where
+    supported — spelled check_vma on new jax, check_rep on older: the
+    checker has no rule for pallas_call, whose ShapeDtypeStruct outputs
+    carry no varying-mesh-axis annotation, and the bodies here are
+    embarrassingly parallel so the check proves nothing)."""
     try:
         from jax import shard_map as _shard_map
 
         return functools.partial(_shard_map, mesh=mesh, check_vma=False)
     except ImportError:  # older jax
+        import inspect
+
         from jax.experimental.shard_map import shard_map as _shard_map
 
-        return functools.partial(_shard_map, mesh=mesh)
+        kwargs = {"mesh": mesh}
+        if "check_rep" in inspect.signature(_shard_map).parameters:
+            kwargs["check_rep"] = False
+        return functools.partial(_shard_map, **kwargs)
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:

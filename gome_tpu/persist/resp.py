@@ -188,3 +188,85 @@ class RespClient:
 
     def hexists(self, key: str, field: str) -> bool:
         return self.execute_command("HEXISTS", key, field) == 1
+
+
+class SupervisedRespClient:
+    """A RespClient under supervision (utils.resilience.Supervised): a
+    dead store connection reconnects under backoff + circuit breaker, the
+    session is re-established (AUTH/SELECT replay happens in the
+    RespClient constructor), and the failed command retries on the fresh
+    connection. Same surface as RespClient, so RespPrePool, redis_schema
+    and redis_restore take it unchanged.
+
+    Retry semantics: HSET/HGETALL/KEYS/ZRANGE/… retries are idempotent.
+    HDEL (the pre-pool's consume path) has the classic ambiguity window —
+    a server that applied the delete but died before replying makes the
+    retried command report 0 — which maps onto the engine's at-least-once
+    replay exactly like a lost-reply Redis deployment would; exact-once
+    marker consumption across store crashes needs transactional markers,
+    which neither the reference nor this port has."""
+
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 6379,
+        timeout_s: float = 10.0, db: int = 0, password: str | None = None,
+        name: str | None = None, policy=None, breaker=None,
+    ):
+        from ..utils.resilience import Supervised
+
+        def factory():
+            return RespClient(host, port, timeout_s, db, password)
+
+        self._sup = Supervised(
+            name or f"resp:{host}:{port}", factory,
+            policy=policy, breaker=breaker,
+        )
+        # One eager dial, no backoff: boot fallback (service/app.py keeps
+        # the in-process pool when the store is down) must be fast.
+        try:
+            self._sup.prime()
+        except BaseException:
+            self._sup.close()
+            raise
+
+    def supervisor(self):
+        return self._sup
+
+    def execute_command(self, *args):
+        return self._sup.call(lambda c: c.execute_command(*args))
+
+    def pipeline(self, commands: list[tuple]) -> list:
+        return self._sup.call(lambda c: c.pipeline(commands))
+
+    # RespClient's full read/convenience surface, supervised.
+    def keys(self, pattern: str = "*") -> list[str]:
+        return self._sup.call(lambda c: c.keys(pattern))
+
+    def zrange(self, key: str, start: int = 0, end: int = -1) -> list[str]:
+        return self._sup.call(lambda c: c.zrange(key, start, end))
+
+    def hgetall(self, key: str) -> dict[str, str]:
+        return self._sup.call(lambda c: c.hgetall(key))
+
+    def ping(self) -> bool:
+        return self._sup.call(lambda c: c.ping())
+
+    def flushdb(self) -> None:
+        return self._sup.call(lambda c: c.flushdb())
+
+    def hset(self, key: str, field: str, value: str) -> int:
+        return self._sup.call(lambda c: c.hset(key, field, value))
+
+    def hdel(self, key: str, *fields: str) -> int:
+        return self._sup.call(lambda c: c.hdel(key, *fields))
+
+    def hexists(self, key: str, field: str) -> bool:
+        return self._sup.call(lambda c: c.hexists(key, field))
+
+    def close(self) -> None:
+        self._sup.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

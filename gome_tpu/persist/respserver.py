@@ -91,11 +91,51 @@ class FakeRedisServer:
                 self._listener.close()
             except OSError:
                 pass
+            # Wake the accept thread: blocked accept() holds the listener's
+            # open file description, so the LISTEN socket would linger
+            # (blocking a same-port restart) until a connection arrives.
+            try:
+                socket.create_connection(
+                    (self.host, self.port), timeout=0.2
+                ).close()
+            except OSError:
+                pass
         for c in self._conns:
+            # shutdown() first: close() alone neither wakes a thread
+            # blocked in recv() on this socket nor tells the peer — the
+            # restart drill needs clients to see the death immediately.
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 c.close()
             except OSError:
                 pass
+
+    def restart(self) -> int:
+        """Fault injection: bounce the server — drop the listener and
+        every live connection (clients see ECONNRESET mid-command, like a
+        real Redis restart), then come back on the SAME port with the
+        SAME keyspace (a restart with an RDB/AOF-backed store; marker
+        state survives, sessions do not). Returns the port."""
+        import time
+
+        port, store = self.port, self.store
+        self.stop()
+        self._stop = threading.Event()
+        self._threads = []
+        self._conns = []
+        self.port = port
+        self.store = store
+        # The dead connections' sockets can hold the port for a beat even
+        # with SO_REUSEADDR; retry the bind briefly rather than flaking.
+        for _ in range(100):
+            try:
+                return self.start()
+            except OSError:
+                time.sleep(0.02)
+        return self.start()
 
     def __enter__(self):
         self.start()

@@ -55,12 +55,16 @@ class EngineService:
             # local Redis/RabbitMQ that may not exist in this environment):
             # warn loudly and keep the in-process pool.
             from ..engine.prepool import RespPrePool
-            from ..persist.resp import RespClient, RespError
+            from ..persist.resp import RespError, SupervisedRespClient
 
             st = self.config.store
             try:
-                client = RespClient(
-                    st.host, st.port, password=st.password or None
+                # Supervised client: a store restart mid-traffic reconnects
+                # under backoff + breaker and replays the session
+                # (utils.resilience) instead of killing the marker path.
+                client = SupervisedRespClient(
+                    st.host, st.port, password=st.password or None,
+                    name="resp:store",
                 )
                 # Validate the session up front (a reachable-but-unusable
                 # store, e.g. NOAUTH, must fall back at boot — not fail

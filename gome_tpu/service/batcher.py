@@ -19,14 +19,46 @@ the whole buffer out under the lock), so the per-symbol FIFO invariant
 (SURVEY §5.2) holds through the bridge. Consumers need no changes: the
 order consumer already sniffs frames vs JSON per message, so a deployment
 can switch the gateway to the bridge mid-stream.
-"""
+
+Degraded mode (bus unavailable): a frame whose publish fails with a
+ConnectionError — the supervised bus client raises one when its backoff
+budget is exhausted or its circuit is open — is SPILLED to a bounded
+in-memory deque instead of being lost or blocking handlers forever. The
+deadline thread keeps retrying the spill FIFO (spilled frames always go
+out before younger ones, preserving order); once `spill_max_frames` is
+reached, submit() raises Backpressure and the gateway rejects with a
+RETRYABLE status — bounded buffering with explicit backpressure, never
+unbounded growth and never silent drops. Spill depth and time-in-degraded
+are exported through utils.metrics (scrape-time callback gauges), and
+service/health.py folds them into /healthz."""
 
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 
 from ..bus.colwire import encode_orders
 from ..types import Order
+from ..utils.logging import get_logger
+from ..utils.metrics import REGISTRY
+
+log = get_logger("batcher")
+
+_rejects = REGISTRY.counter(
+    "gome_gateway_retryable_rejects_total",
+    "orders rejected retryable because the degraded-mode spill was full",
+)
+_spilled = REGISTRY.counter(
+    "gome_gateway_spilled_frames_total",
+    "ORDER frames diverted to the in-memory spill on publish failure",
+)
+
+
+class Backpressure(ConnectionError):
+    """The degraded-mode spill is full: the order was NOT accepted and the
+    client should retry later (gateway maps this to a retryable reject).
+    Subclasses ConnectionError so generic bus-fault handling applies."""
 
 
 class FrameBatcher:
@@ -37,22 +69,73 @@ class FrameBatcher:
     on the background deadline thread for the latency bound. close()
     flushes the remainder and stops the deadline thread."""
 
-    def __init__(self, queue, max_n: int = 4096, max_wait_s: float = 0.002):
+    def __init__(
+        self,
+        queue,
+        max_n: int = 4096,
+        max_wait_s: float = 0.002,
+        spill_max_frames: int = 64,
+        retry_interval_s: float = 0.05,
+    ):
         if max_n < 1:
             raise ValueError("max_n must be >= 1")
+        if spill_max_frames < 1:
+            raise ValueError("spill_max_frames must be >= 1")
         self.queue = queue
         self.max_n = max_n
         self.max_wait_s = max_wait_s
+        self.spill_max_frames = spill_max_frames
+        self.retry_interval_s = retry_interval_s
         self._buf: list[Order] = []
+        self._spill: deque[bytes] = deque()  # encoded frames, FIFO
+        self._degraded_since: float | None = None
+        self.degraded_seconds_total = 0.0
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop_event = threading.Event()
         self._stop = False
         self._oldest: float | None = None  # monotonic time of buffer head
+        REGISTRY.callback_gauge(
+            "gome_gateway_spill_depth",
+            "degraded-mode spill depth (ORDER frames awaiting the bus)",
+            lambda: len(self._spill),
+        )
+        REGISTRY.callback_gauge(
+            "gome_gateway_degraded_seconds",
+            "seconds the gateway has been in degraded mode (0 healthy)",
+            lambda: (
+                time.monotonic() - self._degraded_since
+                if self._degraded_since is not None
+                else 0.0
+            ),
+        )
         self._thread = threading.Thread(
             target=self._deadline_loop, name="frame-batcher", daemon=True
         )
         self._thread.start()
+
+    # -- degraded-mode state (callers: gateway handlers, health) -----------
+    @property
+    def degraded(self) -> bool:
+        return self._degraded_since is not None
+
+    def stats(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            degraded_s = (
+                now - self._degraded_since
+                if self._degraded_since is not None
+                else 0.0
+            )
+            return dict(
+                degraded=self._degraded_since is not None,
+                degraded_s=degraded_s,
+                degraded_seconds_total=self.degraded_seconds_total
+                + degraded_s,
+                spill_depth=len(self._spill),
+                spill_max_frames=self.spill_max_frames,
+                buffered=len(self._buf),
+            )
 
     def submit(self, order: Order) -> None:
         """Buffer one accepted order; flush if the size bound tripped.
@@ -66,15 +149,22 @@ class FrameBatcher:
 
         Raises RuntimeError after close(): the deadline thread is gone,
         so a buffered order below max_n would be stranded forever — a
-        late gRPC handler must fail loudly, not accept-and-drop."""
+        late gRPC handler must fail loudly, not accept-and-drop. Raises
+        Backpressure while the degraded-mode spill is full: bounded
+        buffering means at some depth new orders must be refused
+        (retryable) rather than silently queued to infinity."""
         with self._lock:
             if self._stop:
                 raise RuntimeError(
                     "FrameBatcher is closed; order not accepted"
                 )
+            if len(self._spill) >= self.spill_max_frames:
+                _rejects.inc()
+                raise Backpressure(
+                    f"bus degraded: spill full "
+                    f"({self.spill_max_frames} frames); retry later"
+                )
             if not self._buf:
-                import time
-
                 self._oldest = time.monotonic()
                 self._wake.set()
             self._buf.append(order)
@@ -82,15 +172,44 @@ class FrameBatcher:
                 self._flush_locked()
 
     def flush(self) -> int:
-        """Flush whatever is buffered now; returns the count flushed."""
+        """Flush whatever is buffered now; returns the count flushed into
+        a frame (the frame may land in the spill if the bus is down)."""
         with self._lock:
             return self._flush_locked()
 
     def _flush_locked(self) -> int:
         batch = self._swap_locked()
         if batch:
-            self.queue.publish(encode_orders(batch))
+            self._spill.append(encode_orders(batch))
+        self._drain_spill_locked()
         return len(batch)
+
+    def _drain_spill_locked(self) -> None:
+        """Publish spilled frames FIFO (oldest first — frame order on the
+        wire is arrival order even across an outage). A publish fault
+        enters/extends degraded mode and leaves the remainder for the
+        deadline thread's next retry tick."""
+        while self._spill:
+            try:
+                self.queue.publish(self._spill[0])
+            except (ConnectionError, OSError) as e:
+                if self._degraded_since is None:
+                    self._degraded_since = time.monotonic()
+                    _spilled.inc(len(self._spill))
+                    log.warning(
+                        "bus publish failed (%s): degraded mode, "
+                        "%d frame(s) spilled", e, len(self._spill),
+                    )
+                else:
+                    _spilled.inc(1)
+                return
+            self._spill.popleft()
+        if self._degraded_since is not None:
+            self.degraded_seconds_total += (
+                time.monotonic() - self._degraded_since
+            )
+            self._degraded_since = None
+            log.info("bus recovered: degraded mode over, spill drained")
 
     def _swap_locked(self) -> list[Order]:
         batch, self._buf = self._buf, []
@@ -98,18 +217,24 @@ class FrameBatcher:
         return batch
 
     def _deadline_loop(self) -> None:
-        import time
-
         while True:
-            self._wake.wait()
+            with self._lock:
+                spilled = bool(self._spill)
+            if not spilled:
+                self._wake.wait()
             if self._stop:
                 return
             with self._lock:
                 oldest = self._oldest
-                if oldest is None:
+                if oldest is None and not self._spill:
                     self._wake.clear()
                     continue
-            delay = oldest + self.max_wait_s - time.monotonic()
+            if oldest is not None:
+                delay = oldest + self.max_wait_s - time.monotonic()
+            else:
+                # Degraded with an empty buffer: the spill is the only
+                # pending work — retry it on its own cadence.
+                delay = self.retry_interval_s
             if delay > 0:
                 # Interruptible: close() sets the stop event, so a large
                 # max_wait_s never pins the thread (or close's join).
@@ -123,7 +248,9 @@ class FrameBatcher:
                     and time.monotonic() >= self._oldest + self.max_wait_s
                 ):
                     self._flush_locked()
-                if self._oldest is None:
+                elif self._spill:
+                    self._drain_spill_locked()
+                if self._oldest is None and not self._spill:
                     self._wake.clear()
 
     def close(self) -> None:
@@ -139,3 +266,12 @@ class FrameBatcher:
         self._wake.set()
         self._thread.join(timeout=5)
         self.flush()
+        with self._lock:
+            if self._spill:
+                # Bounded loss, loudly: the process is exiting with the
+                # bus still down. The spill was never acknowledged past
+                # the gateway's accept, and at-least-once clients retry.
+                log.error(
+                    "closing with %d undelivered spilled frame(s) — "
+                    "bus still down", len(self._spill),
+                )

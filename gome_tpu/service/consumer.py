@@ -20,6 +20,7 @@ from ..bus import QueueBus, decode_orders_batch
 from ..engine.orchestrator import MatchEngine
 from ..utils.logging import get_logger
 from ..utils.metrics import REGISTRY
+from ..utils.resilience import BackoffPolicy, backoff_delays
 from ..utils.tracing import annotate
 
 log = get_logger("consumer")
@@ -43,6 +44,18 @@ _throughput = REGISTRY.gauge(
 _poisoned = REGISTRY.counter(
     "gome_poison_orders_total",
     "orders dead-lettered by the poison-batch policy",
+)
+_step_failures = REGISTRY.counter(
+    "gome_consumer_step_failures_total",
+    "consumer steps that raised (bus fault, device error, poison batch)",
+)
+
+#: Backoff between consecutive FAILED consumer/feed steps: a dead bus must
+#: not busy-spin the loop (each failed poll would otherwise burn a core
+#: re-raising the same ConnectionError); a transient fault retries almost
+#: immediately. Reset on the first successful step.
+FAULT_BACKOFF = BackoffPolicy(
+    base_s=0.01, max_s=1.0, max_retries=1_000_000, budget_s=float("inf")
 )
 
 
@@ -99,6 +112,7 @@ class OrderConsumer:
         self.poison_threshold = poison_threshold
         self._fail_offset = -1
         self._fail_count = 0
+        self._last_step_failed = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -303,19 +317,31 @@ class OrderConsumer:
         self._thread.start()
 
     def _loop(self) -> None:
+        # Consecutive failures back off (decorrelated jitter) instead of
+        # busy-spinning against a dead dependency; any success resets.
+        delays = None
         while not self._stop.is_set():
             self.step_with_policy()
+            if self._last_step_failed:
+                if delays is None:
+                    delays = backoff_delays(FAULT_BACKOFF)
+                self._stop.wait(next(delays, FAULT_BACKOFF.max_s))
+            else:
+                delays = None
 
     def step_with_policy(self) -> int:
         """One consumer step with the poison-batch policy applied. Returns
         orders processed (0 on a failed or empty step). Never raises — the
         consumer thread must survive any failure (the reference panics
         instead; a transient bus outage must not kill matching)."""
+        self._last_step_failed = False
         try:
             n = self.run_once()
             self._fail_count = 0
             return n
         except Exception:  # keep consuming; reference panics instead
+            self._last_step_failed = True
+            _step_failures.inc()
             log.exception("order batch failed")
             try:
                 offset = self.bus.order_queue.committed()

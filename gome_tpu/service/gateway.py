@@ -30,6 +30,14 @@ from ..utils.logging import get_logger
 
 log = get_logger("gateway")
 
+#: Edge-reject status codes on the reference-shaped OrderResponse.code
+#: field: 3 = permanent reject (invalid order, gateway shut down — do not
+#: retry), RETRYABLE = the pipeline is degraded (bus down + spill full /
+#: circuit open); the order was NOT accepted and a retry later should
+#: succeed. 14 matches gRPC UNAVAILABLE by convention.
+CODE_REJECT = 3
+CODE_RETRYABLE = 14
+
 
 def order_from_request(
     request: pb.OrderRequest, action: Action, accuracy: int
@@ -110,14 +118,21 @@ class OrderGateway:
         self._mark(order)  # pre-pool before queueing (main.go:44-45)
         try:
             self._emit(order)
-        except (RuntimeError, ConnectionError, OSError) as e:
-            # Emit failed — batcher closed mid-shutdown (RuntimeError) or
-            # the bus connection dropped (ConnectionError/OSError). The
-            # order was NOT published, so the mark must not dangle (the
-            # consumer would never clear it) and the client must hear a
-            # rejection, not a gRPC UNKNOWN.
+        except (ConnectionError, OSError) as e:
+            # Bus degraded (spill full / circuit open / reconnect budget
+            # exhausted): the order was NOT accepted into the pipeline, so
+            # the mark must not dangle — and the client hears an explicit
+            # RETRYABLE status instead of a gRPC UNKNOWN or a silent drop.
             self._unmark(order)
-            return pb.OrderResponse(code=3, message=f"rejected: {e}")
+            return pb.OrderResponse(
+                code=CODE_RETRYABLE, message=f"degraded, retry: {e}"
+            )
+        except RuntimeError as e:
+            # Batcher closed mid-shutdown: permanent for this process.
+            self._unmark(order)
+            return pb.OrderResponse(
+                code=CODE_REJECT, message=f"rejected: {e}"
+            )
         # main.go:49: unconditional success; matching outcome arrives async.
         return pb.OrderResponse(code=0, message="order accepted")
 
@@ -131,9 +146,15 @@ class OrderGateway:
         # ride the same batcher so the DEL-after-ADD order is preserved.
         try:
             self._emit(order)
-        except (RuntimeError, ConnectionError, OSError) as e:
-            # Batcher closed or bus down: reject, don't crash the handler.
-            return pb.OrderResponse(code=3, message=f"rejected: {e}")
+        except (ConnectionError, OSError) as e:
+            return pb.OrderResponse(
+                code=CODE_RETRYABLE, message=f"degraded, retry: {e}"
+            )
+        except RuntimeError as e:
+            # Batcher closed: reject, don't crash the handler.
+            return pb.OrderResponse(
+                code=CODE_REJECT, message=f"rejected: {e}"
+            )
         return pb.OrderResponse(code=0, message="cancel accepted")
 
     def _apply_entries(self, entries) -> pb.OrderBatchResponse:
@@ -141,10 +162,11 @@ class OrderGateway:
         is_cancel) pairs in order — per-entry validation rejects are
         collected (parallel reject_index/rejects arrays), accepted
         entries mark + emit exactly like their unary counterparts. An
-        emit failure (batcher closed / bus down) stops the batch: the
-        response carries code 3 and `accepted` says how many entries
-        made it into the pipeline before the failure (at-most-once for
-        the remainder — the client resubmits them)."""
+        emit failure stops the batch: the response carries CODE_RETRYABLE
+        when the bus is degraded (retry the remainder later) or
+        CODE_REJECT when the batcher is closed, and `accepted` says how
+        many entries made it into the pipeline before the failure
+        (at-most-once for the remainder — the client resubmits them)."""
         resp = pb.OrderBatchResponse()
         accepted = 0
         for i, (request, is_cancel) in enumerate(entries):
@@ -157,12 +179,7 @@ class OrderGateway:
                     resp.reject_index.append(i)
                     resp.rejects.add(code=3, message=f"rejected: {e}")
                     continue
-                try:
-                    self._emit(order)
-                except (RuntimeError, ConnectionError, OSError) as e:
-                    resp.code = 3
-                    resp.message = f"batch aborted at entry {i}: {e}"
-                    break
+                unmark_on_fail = False
             else:
                 try:
                     order = self._validate_add(request)
@@ -171,13 +188,19 @@ class OrderGateway:
                     resp.rejects.add(code=3, message=f"rejected: {e}")
                     continue
                 self._mark(order)
-                try:
-                    self._emit(order)
-                except (RuntimeError, ConnectionError, OSError) as e:
+                unmark_on_fail = True
+            try:
+                self._emit(order)
+            except (RuntimeError, ConnectionError, OSError) as e:
+                if unmark_on_fail:
                     self._unmark(order)
-                    resp.code = 3
-                    resp.message = f"batch aborted at entry {i}: {e}"
-                    break
+                resp.code = (
+                    CODE_RETRYABLE
+                    if isinstance(e, (ConnectionError, OSError))
+                    else CODE_REJECT
+                )
+                resp.message = f"batch aborted at entry {i}: {e}"
+                break
             accepted += 1
         resp.accepted = accepted
         return resp

@@ -8,7 +8,11 @@ persist+file-bus replay, and this module adds the missing observability and
 supervision:
 
   HealthMonitor — point-in-time health snapshot: thread liveness,
-                  heartbeat age, queue lags, engine capacity pressure.
+                  heartbeat age, queue lags, engine capacity pressure,
+                  and per-connection resilience state (breaker state,
+                  reconnect/retry counts, time degraded — every
+                  utils.resilience.Supervised in the process) plus the
+                  gateway's degraded-mode spill (service.batcher).
   Watchdog      — periodic checks with a restart policy for dead loops
                   (bounded restarts — persistent crash loops surface
                   instead of flapping forever).
@@ -72,6 +76,15 @@ class HealthMonitor:
         age = time.monotonic() - self._beat
         stalled = consumer_alive and order_lag > 0 and age > self.stall_after_s
         healthy = consumer_alive and feed_alive and not stalled
+        from ..utils.resilience import resilience_snapshot
+
+        connections = resilience_snapshot()
+        degraded = any(c["breaker"] != "closed" for c in connections.values())
+        gateway = {}
+        batcher = getattr(svc.gateway, "_batcher", None)
+        if batcher is not None:
+            gateway = batcher.stats()
+            degraded = degraded or gateway.get("degraded", False)
         return Health(
             healthy=healthy,
             consumer_alive=consumer_alive,
@@ -85,6 +98,12 @@ class HealthMonitor:
                 "orders_processed": batch.stats.orders,
                 "cap_escalations": batch.stats.cap_escalations,
                 "device_calls": batch.stats.device_calls,
+                # Transport degradation is NOT unhealthy (matching keeps
+                # running; durability covers the gap) but operators need
+                # to see it: supervised-connection + spill state.
+                "degraded": degraded,
+                "connections": connections,
+                "gateway": gateway,
             },
         )
 

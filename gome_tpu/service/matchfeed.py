@@ -118,11 +118,19 @@ class MatchFeed:
         self._thread.start()
 
     def _loop(self) -> None:
+        from ..utils.resilience import backoff_delays
+        from .consumer import FAULT_BACKOFF
+
+        delays = None  # backoff across consecutive failures (dead bus)
         while not self._stop.is_set():
             try:
                 self.run_once()
+                delays = None
             except Exception:
                 log.exception("match feed batch failed")
+                if delays is None:
+                    delays = backoff_delays(FAULT_BACKOFF)
+                self._stop.wait(next(delays, FAULT_BACKOFF.max_s))
 
     def stop(self) -> None:
         self._stop.set()

@@ -30,6 +30,16 @@ class Registry:
     ) -> "Histogram":
         return self._get(name, lambda: Histogram(name, help, buckets))
 
+    def callback_gauge(self, name: str, help: str, fn) -> "CallbackGauge":
+        """A gauge whose value is read from `fn()` at scrape time — for
+        state that already lives somewhere (spill depth, breaker state)
+        and would otherwise need push updates on every change. Re-
+        registering the same name rebinds the callback (components are
+        rebuilt across service restarts in tests)."""
+        g = self._get(name, lambda: CallbackGauge(name, help, fn))
+        g._fn = fn
+        return g
+
     def _get(self, name, factory):
         with self._lock:
             m = self._metrics.get(name)
@@ -86,6 +96,29 @@ class Gauge:
     def value(self):
         with self._lock:
             return self._v
+
+    def render(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n# TYPE {self.name} gauge\n"
+            f"{self.name} {self.value()}"
+        )
+
+
+class CallbackGauge:
+    """Gauge evaluated at scrape time (see Registry.callback_gauge). A
+    failing callback scrapes as 0 rather than breaking the whole /metrics
+    exposition."""
+
+    def __init__(self, name: str, help: str, fn):
+        self.name = name
+        self.help = help
+        self._fn = fn
+
+    def value(self):
+        try:
+            return float(self._fn())
+        except Exception:
+            return 0.0
 
     def render(self) -> str:
         return (

@@ -5,13 +5,31 @@ run without TPU hardware, and enables x64 so int64 tick/lot arithmetic is
 exact (SURVEY §2.2).
 
 Note: this image's sitecustomize imports jax at interpreter startup with
-JAX_PLATFORMS=axon (the tunneled real TPU), so env vars alone are too late —
-platform and device count must be set via jax.config before the (lazy) first
-backend initialization, which is why this conftest does it at import time.
+JAX_PLATFORMS=axon (the tunneled real TPU), so the platform must be forced
+via jax.config before the (lazy) first backend initialization — importing
+jax does NOT initialize a backend, so doing it at conftest import time is
+early enough. The virtual device COUNT has two spellings across JAX
+releases: newer JAX has a `jax_num_cpu_devices` config option; older
+releases (0.4.37 rejects the option with AttributeError) only honor the
+XLA_FLAGS --xla_force_host_platform_device_count flag, which is likewise
+read at backend init, not at import. Set both, flag first.
 """
+
+import os
+
+_FORCE = "--xla_force_host_platform_device_count=8"
+if "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _FORCE
+    ).strip()
 
 import jax
 
-jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # pre-0.5 JAX: the XLA_FLAGS spelling above applies instead

@@ -212,7 +212,9 @@ def test_reference_config_full_amqp_service(tmp_path, broker):
     svc = EngineService(cfg)
     svc.start()
     try:
-        assert isinstance(svc.bus.order_queue, AmqpQueue)
+        from gome_tpu.bus.amqp import SupervisedAmqpQueue
+
+        assert isinstance(svc.bus.order_queue, SupervisedAmqpQueue)
         r1 = svc.gateway.DoOrder(
             pb.OrderRequest(uuid="u1", oid="a", symbol="eth2usdt",
                             transaction=pb.SALE, price=1.0, volume=5.0),

@@ -266,8 +266,21 @@ _CRASH_CONSUMER = r"""
 import os
 import sys
 sys.path.insert(0, {repo!r})
+mesh_n = {mesh_n}
+if mesh_n:
+    # Virtual CPU devices: flag spelling for older jax (read at backend
+    # init), config option for newer — same dance as tests/conftest.py.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
+if mesh_n:
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass
 from gome_tpu.bus import make_bus
 from gome_tpu.config import BusConfig, PersistConfig
 from gome_tpu.engine.book import BookConfig
@@ -278,10 +291,8 @@ from gome_tpu.persist.snapshot import Persister
 from gome_tpu.service.consumer import OrderConsumer
 
 bus = make_bus(BusConfig(backend="file", dir={busdir!r}))
-mesh_n = {mesh_n}
 mesh = None
 if mesh_n:
-    jax.config.update("jax_num_cpu_devices", 8)
     from gome_tpu.parallel import make_mesh
     mesh = make_mesh(mesh_n)
 engine = MatchEngine(BookConfig(cap=64, max_fills=8), n_slots=8, mesh=mesh)

@@ -1,0 +1,353 @@
+"""Deterministic fault-schedule drills for the supervised connection layer:
+broker death mid-publish and mid-consume (bus.amqp.SupervisedAmqpQueue over
+bus.fakebroker's fault modes), RESP store restart mid-mark
+(persist.resp.SupervisedRespClient over persist.respserver), and the
+acceptance drill — a pipelined consumer run with >= 3 scripted disconnects
+whose matchOrder stream must be byte-identical to a fault-free oracle run
+(at-least-once redelivery + commit-after-publish composing with reconnects
+gives no lost and no duplicated fills)."""
+
+import time
+
+import pytest
+
+from gome_tpu.bus.amqp import SupervisedAmqpQueue
+from gome_tpu.bus.fakebroker import FakeBroker
+from gome_tpu.utils.resilience import BackoffPolicy
+
+#: Fast schedule for drills: real reconnects, no test-visible latency.
+FAST = BackoffPolicy(base_s=0.005, max_s=0.05, max_retries=60, budget_s=30)
+
+
+def make_queue(name, broker):
+    return SupervisedAmqpQueue(name, port=broker.port, policy=FAST)
+
+
+# --- connection-level drills ----------------------------------------------
+
+
+def test_exact_stream_across_repeated_publish_kills():
+    """close_abruptly_on_publish=5: every connection is killed at ITS 5th
+    publish (the killed publish is dropped broker-side — the crash-before-
+    enqueue case). 23 messages force ~5 reconnects; the consumer must see
+    all 23 exactly once, in order."""
+    broker = FakeBroker(close_abruptly_on_publish=5).start()
+    try:
+        producer = make_queue("doOrder", broker)
+        consumer = make_queue("doOrder", broker)
+        bodies = [f"m{i}".encode() for i in range(23)]
+        for b in bodies:
+            producer.publish(b)
+        got = []
+        deadline = time.monotonic() + 20
+        while len(got) < len(bodies) and time.monotonic() < deadline:
+            msgs = consumer.poll_batch(64, 0.2)
+            got = [m.body for m in msgs]
+        assert got == bodies  # no loss, no dup, order preserved
+        snap = producer.supervisor().snapshot()
+        assert snap["connects_total"] >= 4  # ≥3 disconnects survived
+        producer.close()
+        consumer.close()
+    finally:
+        broker.stop()
+
+
+def test_redelivery_resumes_exact_offsets_after_consume_kill():
+    """Kill the consumer's connection mid-stream: committed (acked)
+    messages must NOT redeliver; everything past the committed cursor
+    redelivers at the SAME wrapper offsets, in order."""
+    broker = FakeBroker().start()
+    try:
+        producer = make_queue("doOrder", broker)
+        consumer = make_queue("doOrder", broker)
+        for i in range(10):
+            producer.publish(f"m{i}".encode())
+        msgs = consumer.poll_batch(10, 5.0)
+        assert len(msgs) == 10
+        consumer.commit(4)  # m0..m3 acked broker-side
+        assert broker.kill_connections(consuming="doOrder") == 1
+        # resume: the uncommitted tail redelivers at offsets 4..9
+        deadline = time.monotonic() + 20
+        tail = []
+        while len(tail) < 6 and time.monotonic() < deadline:
+            tail = consumer.poll_batch(16, 0.2)
+        assert [(m.offset, m.body) for m in tail] == [
+            (i, f"m{i}".encode()) for i in range(4, 10)
+        ]
+        consumer.commit(10)
+        producer.publish(b"late")
+        late = consumer.poll_batch(1, 5.0)
+        assert [(m.offset, m.body) for m in late] == [(10, b"late")]
+        assert consumer.supervisor().snapshot()["connects_total"] >= 2
+        producer.close()
+        consumer.close()
+    finally:
+        broker.stop()
+
+
+def test_channel_close_fault_reconnects_and_retries():
+    """Server-initiated Channel.Close (resource fault) instead of a dead
+    socket: the supervised queue must also recover from protocol-level
+    connection failure."""
+    broker = FakeBroker(channel_close_on_publish=3).start()
+    try:
+        q = make_queue("doOrder", broker)
+        for i in range(8):
+            q.publish(f"m{i}".encode())
+        msgs = q.poll_batch(8, 10.0)
+        assert [m.body for m in msgs] == [f"m{i}".encode() for i in range(8)]
+        assert q.supervisor().snapshot()["connects_total"] >= 2
+        q.close()
+    finally:
+        broker.stop()
+
+
+# --- RESP store drills ----------------------------------------------------
+
+
+def test_resp_store_restarts_mid_mark():
+    """Three server restarts interleaved with pre-pool marking: the
+    supervised client reconnects + retries (HSET marking is idempotent
+    under retry), and the consume pass at the end sees every mark exactly
+    once."""
+    from gome_tpu.engine.prepool import RespPrePool
+    from gome_tpu.persist.resp import SupervisedRespClient
+    from gome_tpu.persist.respserver import FakeRedisServer
+
+    srv = FakeRedisServer()
+    port = srv.start()
+    try:
+        client = SupervisedRespClient(
+            port=port, policy=FAST, name="resp:drill"
+        )
+        pool = RespPrePool(client)
+        keys = [("eth2usdt", "u", f"oid{i}") for i in range(12)]
+        for i, k in enumerate(keys):
+            if i in (3, 6, 9):  # restart schedule: mid-mark, three times
+                srv.restart()
+            pool.add(k)
+        assert pool.resilience()["connects_total"] >= 4
+        assert pool.consume_batch(keys) == [True] * len(keys)
+        assert pool.consume_batch(keys) == [False] * len(keys)  # consumed
+        client.close()
+    finally:
+        srv.stop()
+
+
+# --- the acceptance drill -------------------------------------------------
+
+
+def _mk_engine():
+    import jax.numpy as jnp
+
+    from gome_tpu.engine.book import BookConfig
+    from gome_tpu.engine.orchestrator import MatchEngine
+
+    return MatchEngine(
+        config=BookConfig(cap=32, max_fills=8, dtype=jnp.int64),
+        n_slots=16,
+        max_t=8,
+    )
+
+
+def _run_flow(engine, bus, orders, mid_kill=None):
+    """Gateway-style feed (mark ADDs, publish each order) + consumer drain.
+    mid_kill(processed_so_far) is called between consumer steps so drills
+    can kill connections at scripted points. Returns the matchOrder
+    bodies."""
+    from gome_tpu.bus import encode_order
+    from gome_tpu.service.consumer import OrderConsumer
+    from gome_tpu.types import Action
+
+    for o in orders:
+        if o.action is Action.ADD:
+            engine.mark(o)
+        bus.order_queue.publish(encode_order(o))
+    consumer = OrderConsumer(engine, bus, batch_n=16, batch_wait_s=0.01)
+    deadline = time.monotonic() + 60
+    while (
+        bus.order_queue.committed() < bus.order_queue.end_offset()
+        and time.monotonic() < deadline
+    ):
+        n = consumer.step_with_policy()
+        if mid_kill is not None:
+            mid_kill(bus.order_queue.committed())
+    assert bus.order_queue.committed() == bus.order_queue.end_offset()
+    mq = bus.match_queue
+    return [m.body for m in mq.read_from(0, mq.end_offset())]
+
+
+def test_fault_schedule_match_stream_is_oracle_exact():
+    """THE acceptance drill: >= 3 scripted broker disconnects during a
+    consumer run (publish-side kills via close_abruptly_on_publish on the
+    order feed AND the event publishes, plus one scripted mid-consume
+    connection kill) — the resulting matchOrder stream must be
+    byte-identical to a fault-free oracle run on the memory bus, and the
+    supervisors must report the reconnects."""
+    from gome_tpu.bus import QueueBus
+    from gome_tpu.bus.memory import MemoryQueue
+    from gome_tpu.utils.streams import multi_symbol_stream
+
+    orders = list(
+        multi_symbol_stream(n=120, n_symbols=4, seed=11, cancel_prob=0.2)
+    )
+
+    # Oracle: fault-free run on the in-process bus.
+    oracle_engine = _mk_engine()
+    oracle_bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    oracle = _run_flow(oracle_engine, oracle_bus, orders)
+    assert oracle, "oracle run produced no match events"
+
+    # Fault run: every connection dies at its 9th publish (order feed AND
+    # match-event publishes), plus one scripted consumer-connection kill
+    # partway through the drain.
+    broker = FakeBroker(close_abruptly_on_publish=9).start()
+    try:
+        bus = QueueBus(
+            make_queue("doOrder", broker), make_queue("matchOrder", broker)
+        )
+        engine = _mk_engine()
+        kills = {"consume": 0}
+
+        def mid_kill(committed):
+            if committed >= 40 and not kills["consume"]:
+                kills["consume"] = broker.kill_connections(
+                    consuming="doOrder"
+                )
+
+        got = _run_flow(engine, bus, orders, mid_kill=mid_kill)
+        assert got == oracle  # no lost fills, no duplicated fills
+        assert kills["consume"] == 1  # the mid-consume kill really fired
+        reconnects = sum(
+            q.supervisor().snapshot()["connects_total"] for q in
+            (bus.order_queue, bus.match_queue)
+        )
+        assert reconnects >= 5  # >= 3 disconnects across the run
+        bus.order_queue.close()
+        bus.match_queue.close()
+    finally:
+        broker.stop()
+
+
+# --- degraded mode + health/metrics surfaces ------------------------------
+
+
+def test_gateway_degraded_mode_backpressure_and_recovery():
+    """Bus down: accepted orders spill (bounded); when the spill cap is
+    hit DoOrder answers the RETRYABLE status and unmarks; when the bus
+    recovers the spill drains in order and acceptance resumes."""
+    from gome_tpu.api import order_pb2 as pb
+    from gome_tpu.service.batcher import FrameBatcher
+    from gome_tpu.service.gateway import CODE_RETRYABLE, OrderGateway
+
+    class FlakyQueue:
+        def __init__(self):
+            self.up = True
+            self.published = []
+
+        def publish(self, body):
+            if not self.up:
+                raise ConnectionError("bus down")
+            self.published.append(body)
+
+    q = FlakyQueue()
+    batcher = FrameBatcher(
+        q, max_n=4, max_wait_s=0.01, spill_max_frames=2,
+        retry_interval_s=0.01,
+    )
+    marks = set()
+    gw = OrderGateway(
+        bus=None, accuracy=2,
+        mark=lambda o: marks.add(o.oid),
+        unmark=lambda o: marks.discard(o.oid),
+        batcher=batcher,
+    )
+
+    def req(i):
+        return pb.OrderRequest(
+            uuid="u", oid=str(i), symbol="eth2usdt", transaction=1,
+            price=1.0, volume=1.0, kind=1,
+        )
+
+    q.up = False
+    i = 0
+    deadline = time.monotonic() + 10
+    r = None
+    while time.monotonic() < deadline:
+        r = gw.DoOrder(req(i), None)
+        i += 1
+        if r.code == CODE_RETRYABLE:
+            break
+        time.sleep(0.005)
+    assert r is not None and r.code == CODE_RETRYABLE
+    assert str(i - 1) not in marks  # rejected order was unmarked
+    st = batcher.stats()
+    assert st["degraded"] and st["spill_depth"] >= 2
+    q.up = True  # bus recovers: spill drains, acceptance resumes
+    deadline = time.monotonic() + 10
+    while batcher.stats()["spill_depth"] and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert batcher.stats()["spill_depth"] == 0
+    assert not batcher.degraded
+    assert gw.DoOrder(req(999), None).code == 0
+    batcher.close()
+    assert q.published  # every spilled frame made it out
+    from gome_tpu.utils.metrics import REGISTRY
+
+    text = REGISTRY.render()
+    assert "gome_gateway_spill_depth" in text
+    assert "gome_gateway_retryable_rejects_total" in text
+
+
+def test_healthz_reports_connections_and_breaker_transitions():
+    """/healthz (health.HealthMonitor) folds per-connection supervisor
+    state in; /metrics carries the per-connection gauges; a breaker that
+    opened shows its transitions."""
+    from gome_tpu.service.health import HealthMonitor
+    from gome_tpu.utils.metrics import REGISTRY
+    from gome_tpu.utils.resilience import CircuitBreaker, Supervised
+
+    breaker = CircuitBreaker(failure_threshold=2, reset_timeout_s=60.0)
+
+    def dead_factory():
+        raise ConnectionRefusedError("down")
+
+    sup = Supervised(
+        "drill:conn", dead_factory,
+        policy=BackoffPolicy(base_s=0.001, max_s=0.002, max_retries=3,
+                             budget_s=5),
+        breaker=breaker, sleep=lambda s: None,
+    )
+    with pytest.raises(ConnectionError):
+        sup.get()
+    assert breaker.state == "open"
+    assert ("closed", "open") in breaker.transitions
+
+    class _Stub:  # minimal EngineService shape for HealthMonitor
+        pass
+
+    svc = _Stub()
+    svc.consumer = _Stub(); svc.consumer._thread = None
+    svc.feed = _Stub(); svc.feed._thread = None
+
+    class _Q:
+        def end_offset(self): return 0
+        def committed(self): return 0
+
+    svc.bus = _Stub(); svc.bus.order_queue = _Q(); svc.bus.match_queue = _Q()
+    eng = _Stub(); eng.batch = _Stub()
+    eng.batch.symbols = {}; eng.batch.max_slots = 1
+    stats = _Stub(); stats.orders = 0; stats.cap_escalations = 0
+    stats.device_calls = 0
+    eng.batch.stats = stats
+    svc.engine = eng
+    svc.gateway = _Stub()
+
+    h = HealthMonitor(svc).check()
+    conns = h.detail["connections"]
+    assert "drill:conn" in conns
+    assert conns["drill:conn"]["breaker"] == "open"
+    assert h.detail["degraded"] is True
+    text = REGISTRY.render()
+    assert "gome_conn_breaker_state_drill_conn 2" in text
+    sup.close()

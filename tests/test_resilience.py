@@ -1,0 +1,260 @@
+"""Unit tests for the resilience layer (gome_tpu.utils.resilience):
+backoff/jitter bounds, retry budgets, circuit-breaker state transitions
+(fake clock — no real sleeping), and the Supervised connection wrapper's
+reconnect + re-setup-hook + retry semantics."""
+
+import random
+
+import pytest
+
+from gome_tpu.utils.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BackoffPolicy,
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryBudget,
+    RetryBudgetExceeded,
+    Supervised,
+    backoff_delays,
+    resilience_snapshot,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --- backoff --------------------------------------------------------------
+
+
+def test_backoff_delays_within_bounds():
+    pol = BackoffPolicy(base_s=0.05, max_s=2.0, max_retries=50)
+    rng = random.Random(7)
+    delays = list(backoff_delays(pol, rng))
+    assert len(delays) == 50
+    assert delays[0] == pol.base_s
+    for d in delays:
+        assert pol.base_s <= d <= pol.max_s
+
+
+def test_backoff_decorrelated_jitter_growth():
+    """Each delay is Uniform(base, 3*prev) clamped — so the sequence can
+    grow past a pure-exponential schedule's early steps but never past
+    max_s, and two seeds give different schedules (that is the point)."""
+    pol = BackoffPolicy(base_s=0.1, max_s=10.0, max_retries=20)
+    a = list(backoff_delays(pol, random.Random(1)))
+    b = list(backoff_delays(pol, random.Random(2)))
+    assert a != b
+    for prev, nxt in zip(a, a[1:]):
+        assert nxt <= max(3.0 * prev, pol.base_s) + 1e-9
+
+
+def test_backoff_policy_validation():
+    with pytest.raises(ValueError):
+        BackoffPolicy(base_s=0)
+    with pytest.raises(ValueError):
+        BackoffPolicy(base_s=1.0, max_s=0.5)
+    with pytest.raises(ValueError):
+        BackoffPolicy(max_retries=0)
+
+
+# --- retry budget ---------------------------------------------------------
+
+
+def test_retry_budget_spends_and_refills():
+    clock = FakeClock()
+    b = RetryBudget(rate=1.0, burst=2.0, clock=clock)
+    assert b.try_spend() and b.try_spend()
+    assert not b.try_spend()  # empty
+    clock.advance(1.0)  # one token accrues
+    assert b.try_spend()
+    assert not b.try_spend()
+    clock.advance(100.0)  # caps at burst
+    assert b.tokens() == pytest.approx(2.0)
+
+
+# --- circuit breaker ------------------------------------------------------
+
+
+def test_breaker_full_cycle():
+    clock = FakeClock()
+    br = CircuitBreaker(
+        failure_threshold=3, reset_timeout_s=5.0, clock=clock
+    )
+    assert br.state == CLOSED and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CLOSED  # under threshold
+    br.record_failure()
+    assert br.state == OPEN
+    assert not br.allow()  # fail fast while open
+    clock.advance(4.9)
+    assert not br.allow()
+    clock.advance(0.2)  # cooldown elapsed
+    assert br.state == HALF_OPEN
+    assert br.allow()  # one probe admitted
+    assert not br.allow()  # half_open_max=1: second probe refused
+    br.record_failure()  # probe failed -> re-open, cooldown restarts
+    assert br.state == OPEN
+    clock.advance(5.1)
+    assert br.allow()
+    br.record_success()  # probe succeeded -> closed
+    assert br.state == CLOSED
+    assert (CLOSED, OPEN) in br.transitions
+    assert (HALF_OPEN, CLOSED) in br.transitions
+    assert br.opened_total == 2
+
+
+def test_breaker_success_resets_failure_streak():
+    br = CircuitBreaker(failure_threshold=2, clock=FakeClock())
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == CLOSED  # streak broken; not 2 consecutive
+
+
+# --- Supervised -----------------------------------------------------------
+
+
+class FlakyConn:
+    def __init__(self, fail_ops=0):
+        self.fail_ops = fail_ops
+        self.ops = 0
+        self.closed = False
+
+    def op(self):
+        self.ops += 1
+        if self.fail_ops > 0:
+            self.fail_ops -= 1
+            raise ConnectionError("flaky op")
+        return "ok"
+
+    def close(self):
+        self.closed = True
+
+
+def _sup(name, factory, clock=None, **kw):
+    clock = clock or FakeClock()
+    kw.setdefault("policy", BackoffPolicy(base_s=0.001, max_s=0.01,
+                                          max_retries=5, budget_s=100))
+    return Supervised(
+        name, factory, clock=clock, sleep=lambda s: None,
+        rng=random.Random(3), **kw
+    )
+
+
+def test_supervised_reconnects_and_retries_op():
+    conns = []
+
+    def factory():
+        c = FlakyConn()
+        conns.append(c)
+        return c
+
+    sup = _sup("t:retry", factory)
+    first = sup.get()
+    first.fail_ops = 1  # next op faults once
+    assert sup.call(lambda c: c.op()) == "ok"
+    assert len(conns) == 2  # faulted conn replaced
+    assert conns[0].closed  # torn down, not leaked
+    assert sup.retries_total == 1
+    sup.close()
+
+
+def test_supervised_retry_op_false_reraises_but_reconnects():
+    conns = []
+
+    def factory():
+        c = FlakyConn()
+        conns.append(c)
+        return c
+
+    sup = _sup("t:noretry", factory)
+    sup.get().fail_ops = 1
+    with pytest.raises(ConnectionError):
+        sup.call(lambda c: c.op(), retry_op=False)
+    # the NEXT call runs on a fresh connection
+    assert sup.call(lambda c: c.op()) == "ok"
+    assert len(conns) == 2
+    sup.close()
+
+
+def test_supervised_on_reconnect_hooks_fire():
+    seen = []
+
+    sup = _sup("t:hooks", FlakyConn, on_reconnect=[seen.append])
+    c1 = sup.get()
+    assert seen == [c1]  # prime runs hooks too
+    sup.invalidate()
+    c2 = sup.get()
+    assert seen == [c1, c2] and c2 is not c1
+    sup.close()
+
+
+def test_supervised_dial_failure_exhausts_backoff():
+    attempts = []
+
+    def factory():
+        attempts.append(1)
+        raise ConnectionRefusedError("nobody home")
+
+    sup = _sup("t:down", factory)
+    with pytest.raises(RetryBudgetExceeded):
+        sup.get()
+    assert len(attempts) > 1  # actually retried under backoff
+    sup.close()
+
+
+def test_supervised_breaker_opens_and_fails_fast():
+    clock = FakeClock()
+    breaker = CircuitBreaker(
+        failure_threshold=2, reset_timeout_s=60.0, clock=clock
+    )
+
+    def factory():
+        raise ConnectionRefusedError("down hard")
+
+    sup = _sup("t:breaker", factory, clock=clock, breaker=breaker)
+    with pytest.raises(ConnectionError):
+        sup.get()
+    assert breaker.state == OPEN
+    # breaker open: the next get fails in one shot, no dial attempts
+    with pytest.raises(CircuitOpenError):
+        sup.get()
+    # cooldown -> half-open probe is admitted again (and fails -> open)
+    clock.advance(61.0)
+    with pytest.raises(ConnectionError):
+        sup.get()
+    assert breaker.state == OPEN
+    sup.close()
+
+
+def test_supervised_snapshot_and_registry():
+    sup = _sup("t:snap", FlakyConn)
+    sup.get()
+    snap = sup.snapshot()
+    assert snap["breaker"] == CLOSED
+    assert snap["connected"] and snap["connects_total"] == 1
+    assert "t:snap" in resilience_snapshot()
+    sup.close()
+    assert "t:snap" not in resilience_snapshot()
+
+
+def test_supervised_metrics_exported():
+    from gome_tpu.utils.metrics import REGISTRY
+
+    sup = _sup("t:metrics", FlakyConn)
+    sup.get()
+    text = REGISTRY.render()
+    assert "gome_conn_breaker_state_t_metrics" in text
+    assert "gome_conn_reconnects_total_t_metrics" in text
+    sup.close()
