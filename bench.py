@@ -806,6 +806,9 @@ def latency_main():
     from gome_tpu.engine.orchestrator import MatchEngine
     from gome_tpu.service.consumer import OrderConsumer
 
+    from gome_tpu.utils.metrics import Registry
+    from gome_tpu.utils.trace import TRACER, FlightRecorder
+
     N = int(os.environ.get("SVC_ORDERS", 8_192 if check else 1_048_576))
     S = int(os.environ.get("SVC_SYMBOLS", 64 if check else 10_240))
     CAP = int(os.environ.get("SVC_CAP", 32 if check else 256))
@@ -838,6 +841,15 @@ def latency_main():
         make_frame = lambda: flow.frame(frame_n)
         _svc_warmup(engine, consumer, bus, make_frame, symbols)
 
+        # Per-stage breakdown (ISSUE 2): arm the order-lifecycle tracer
+        # for the TIMED region only (warmup excluded), with a private
+        # registry so frame sizes don't pollute each other. The drive
+        # publishes raw frames (no per-order ids), so what lands here are
+        # the batch-scoped engine/consumer stages — pad_pack,
+        # compile_hit/miss, device_execute, decode, publish — i.e. WHERE
+        # the end-to-end latency goes.
+        TRACER.install(FlightRecorder(keep_n=8), registry=Registry())
+
         n_frames = max(PIPE + 2, N // frame_n)
         frames = [make_frame() for _ in range(n_frames)]
         pub_t: list = []  # publish time per frame, FIFO
@@ -867,6 +879,20 @@ def latency_main():
             [d - (p - offs) for p, d in zip(pub_t, done_t)]
         )
         p50, p99, p999 = np.percentile(lat, [50, 99, 99.9])
+        # Per-stage latency breakdown from the tracer's stage histograms:
+        # the BENCH payload then records WHERE the end-to-end time goes
+        # (batch-wait vs pack vs compile vs device vs decode vs publish),
+        # not just that it went.
+        stages = {
+            stage: {
+                "count": v["count"],
+                "p50_us": round(v["p50"] * 1e6, 1),
+                "p99_us": round(v["p99"] * 1e6, 1),
+                "mean_us": round(v["mean"] * 1e6, 1),
+            }
+            for stage, v in sorted(TRACER.stage_summary().items())
+        }
+        TRACER.disable()
         print(
             json.dumps(
                 {
@@ -880,6 +906,7 @@ def latency_main():
                     "p50_ms": round(p50 * 1e3, 1),
                     "p99_ms": round(p99 * 1e3, 1),
                     "p999_ms": round(p999 * 1e3, 1),
+                    "stages": stages,
                 }
             )
         )

@@ -80,6 +80,52 @@ def skip_table(buf: memoryview, off: int) -> int:
 EMPTY_TABLE = struct.pack(">I", 0)
 
 
+def encode_table(d: dict) -> bytes:
+    """AMQP field table: string keys, long-string ('S') values. This is
+    the subset message headers need (trace propagation publishes
+    {"x-trace": "<id>@<t>"}); everything is stringified."""
+    body = b"".join(
+        shortstr(k) + b"S" + longstr(str(v)) for k, v in d.items()
+    )
+    return struct.pack(">I", len(body)) + body
+
+
+def read_table(buf: memoryview, off: int) -> tuple[dict, int]:
+    """Parse an AMQP field table -> (dict, next offset). Recognizes the
+    value types brokers commonly put in headers ('S' long string, 't'
+    bool, 'I' int32, 'l' int64); an unknown type code stops the parse
+    (the table length still advances the offset correctly, so framing
+    never desyncs — we just drop the unparseable tail)."""
+    (n,) = struct.unpack_from(">I", buf, off)
+    off += 4
+    end = off + n
+    out: dict = {}
+    while off < end:
+        key, off = read_shortstr(buf, off)
+        t = buf[off]
+        off += 1
+        if t == 0x53:  # 'S' long string
+            v, off = read_longstr(buf, off)
+            out[key] = v.decode()
+        elif t == 0x74:  # 't' bool
+            out[key] = bool(buf[off])
+            off += 1
+        elif t == 0x49:  # 'I' int32
+            (out[key],) = struct.unpack_from(">i", buf, off)
+            off += 4
+        elif t == 0x6C:  # 'l' int64
+            (out[key],) = struct.unpack_from(">q", buf, off)
+            off += 8
+        else:
+            break
+    return out, end
+
+
+#: basic-properties flag bit for the headers table (AMQP 0-9-1 §4.2.6.1:
+#: content-type bit 15, content-encoding 14, headers 13).
+FLAG_HEADERS = 1 << 13
+
+
 def frame(ftype: int, channel: int, payload: bytes) -> bytes:
     return (
         struct.pack(">BHI", ftype, channel, len(payload))
@@ -121,10 +167,17 @@ def read_frame(sock: socket.socket):
     return ftype, channel, payload
 
 
-def content_frames(channel: int, body: bytes, frame_max: int) -> list[bytes]:
+def content_frames(
+    channel: int, body: bytes, frame_max: int, headers: dict | None = None
+) -> list[bytes]:
     """Content header + body frames for one message (class 60 basic).
-    Zero-length bodies are header-only."""
-    header = struct.pack(">HHQH", 60, 0, len(body), 0)  # no properties
+    Zero-length bodies are header-only. `headers` becomes the
+    basic-properties headers table (trace propagation rides it)."""
+    if headers:
+        props = struct.pack(">HHQH", 60, 0, len(body), FLAG_HEADERS)
+        header = props + encode_table(headers)
+    else:
+        header = struct.pack(">HHQH", 60, 0, len(body), 0)  # no properties
     out = [frame(FRAME_HEADER, channel, header)]
     limit = max(frame_max - 8, 1024)
     for i in range(0, len(body), limit):
@@ -171,6 +224,7 @@ class AmqpQueue(Queue, _Waitable):
         self._buffer: list[bytes] = []  # arrival order
         self._tags: list[int] = []  # delivery tag per arrival
         self._redelivered: list[bool] = []  # Basic.Deliver redelivered bit
+        self._hdrs: list[dict | None] = []  # basic-properties headers
         self._committed = 0
         self._acked_through = 0  # arrivals acked on the broker
         self._published = 0  # our own publishes (loopback sync)
@@ -445,7 +499,7 @@ class AmqpQueue(Queue, _Waitable):
                         _tag, off = read_shortstr(buf, off)
                         dtag, redel = struct.unpack_from(">QB", buf, off)
                         self._pending_deliver = (
-                            (dtag, bool(redel)), bytearray(), [0]
+                            (dtag, bool(redel)), bytearray(), [0], [None]
                         )
                         continue
                     if (class_id, method_id) == (60, 80) and self._confirm:
@@ -492,6 +546,10 @@ class AmqpQueue(Queue, _Waitable):
                     continue  # unsolicited method we don't care about
                 if ftype == FRAME_HEADER and self._pending_deliver:
                     (size,) = struct.unpack_from(">Q", payload, 4)
+                    (flags,) = struct.unpack_from(">H", payload, 12)
+                    if flags & FLAG_HEADERS:
+                        hdrs, _ = read_table(memoryview(payload), 14)
+                        self._pending_deliver[3][0] = hdrs or None
                     self._pending_deliver[2][0] = size
                     if size == 0:
                         self._complete_delivery()
@@ -522,12 +580,13 @@ class AmqpQueue(Queue, _Waitable):
                     ack_cond.notify_all()
 
     def _complete_delivery(self) -> None:
-        (dtag, redelivered), body, _ = self._pending_deliver
+        (dtag, redelivered), body, _, hdr = self._pending_deliver
         self._pending_deliver = None
         with self._lock:
             self._buffer.append(bytes(body))
             self._tags.append(dtag)
             self._redelivered.append(redelivered)
+            self._hdrs.append(hdr[0])
         self._notify_publish()
 
     def _ensure_consuming(self) -> None:
@@ -561,7 +620,9 @@ class AmqpQueue(Queue, _Waitable):
             self._wait_for_publish(0.002)
 
     # -- Queue contract ----------------------------------------------------
-    def publish(self, body: bytes) -> int:
+    supports_headers = True
+
+    def publish(self, body: bytes, headers: dict | None = None) -> int:
         with self._lock:
             if self._closed:
                 raise ConnectionError("AMQP connection is closed")
@@ -574,7 +635,7 @@ class AmqpQueue(Queue, _Waitable):
                 + bytes([0]),
             )
             parts = [frame(FRAME_METHOD, 1, pub)] + content_frames(
-                1, body, self._frame_max
+                1, body, self._frame_max, headers=headers
             )
             self._send(b"".join(parts))
             if not self._confirm:
@@ -610,7 +671,9 @@ class AmqpQueue(Queue, _Waitable):
         self._sync()
         with self._lock:
             return [
-                Message(offset=i, body=self._buffer[i])
+                Message(
+                    offset=i, body=self._buffer[i], headers=self._hdrs[i]
+                )
                 for i in range(
                     offset, min(offset + max_n, len(self._buffer))
                 )
@@ -665,6 +728,7 @@ class AmqpQueue(Queue, _Waitable):
             del self._buffer[offset:]
             del self._tags[offset:]
             del self._redelivered[offset:]
+            del self._hdrs[offset:]
             self._published = min(self._published, offset)
 
     def close(self) -> None:
@@ -748,6 +812,7 @@ class SupervisedAmqpQueue(Queue):
         self._state = threading.Lock()  # log/cursor fields below
         self._io = threading.RLock()  # serializes compound queue ops
         self._log: list[bytes] = []  # wrapper-lifetime arrival log
+        self._log_hdrs: list[dict | None] = []  # headers per arrival
         self._committed = 0
         self._published = 0  # wrapper-lifetime publish count
         self._consuming = False
@@ -831,6 +896,7 @@ class SupervisedAmqpQueue(Queue):
                         self._r += 1
                     else:
                         self._log.append(m.body)
+                        self._log_hdrs.append(m.headers)
                     self._inner_seen = m.offset + 1
                 # Deferred broker acks: ack through the committed cursor
                 # as far as arrivals allow. Inner arrival j maps to log
@@ -855,9 +921,11 @@ class SupervisedAmqpQueue(Queue):
             time.sleep(0.002)
 
     # -- Queue contract ----------------------------------------------------
-    def publish(self, body: bytes) -> int:
+    supports_headers = True
+
+    def publish(self, body: bytes, headers: dict | None = None) -> int:
         with self._io:
-            self._sup.call(lambda q: q.publish(body))
+            self._sup.call(lambda q: q.publish(body, headers=headers))
             with self._state:
                 off = self._published
                 self._published += 1
@@ -868,7 +936,11 @@ class SupervisedAmqpQueue(Queue):
             self._drain(sync=True)
             with self._state:
                 return [
-                    Message(offset=i, body=self._log[i])
+                    Message(
+                        offset=i,
+                        body=self._log[i],
+                        headers=self._log_hdrs[i],
+                    )
                     for i in range(
                         offset, min(offset + max_n, len(self._log))
                     )
@@ -923,6 +995,7 @@ class SupervisedAmqpQueue(Queue):
                 pass  # tail redelivers; recovery truncates again
             with self._state:
                 del self._log[offset:]
+                del self._log_hdrs[offset:]
                 self._published = min(self._published, offset)
                 self._inner_seen = min(
                     self._inner_seen, max(inner_off, 0)

@@ -36,6 +36,11 @@ def encode_order(order: Order) -> bytes:
     }
     if order.order_type is not OrderType.LIMIT:
         body["Kind"] = int(order.order_type)
+    if order.trace is not None:
+        # Order-lifecycle trace context (utils.trace). Extension field
+        # like Kind: absent on reference-shaped messages, ignored by a
+        # reference decoder.
+        body["Trace"] = order.trace
     return json.dumps(body, separators=(",", ":")).encode()
 
 
@@ -50,6 +55,7 @@ def decode_order(body: bytes) -> Order:
         volume=int(d["Volume"]),
         action=Action(d.get("Action", int(Action.ADD))),
         order_type=OrderType(d.get("Kind", 0)),
+        trace=d.get("Trace"),
     )
 
 

@@ -37,6 +37,11 @@ import numpy as np
 
 ORDER_MAGIC = b"GCO2"
 ORDER_MAGIC_V1 = b"GCO1"  # decode-compat: pre-cache dict-column layout
+#: GCO2 + one trailing padded per-order trace-context column (utils.trace
+#: "<id>@<t>" strings; '' = untraced). Emitted only when at least one
+#: order carries a context, so tracing-off traffic stays byte-identical
+#: GCO2 — zero wire overhead on the hot path.
+ORDER_MAGIC_TRACED = b"GCO3"
 EVENT_MAGIC = b"GCE1"
 
 # Order columns: (name, dtype) fixed-width part.
@@ -200,10 +205,14 @@ def encode_order_frame(
     uuids: list[str],
     uuid_idx: np.ndarray,
     oids,
+    traces=None,
 ) -> bytes:
     """Build one ORDER frame. symbols/uuids are per-batch dictionaries with
-    u32 index columns; oids are raw per-order strings (padded column)."""
-    parts = [ORDER_MAGIC, struct.pack("<I", n)]
+    u32 index columns; oids are raw per-order strings (padded column).
+    traces: optional per-order trace-context strings ('' = untraced) —
+    selects the GCO3 layout (a trailing padded column)."""
+    magic = ORDER_MAGIC if traces is None else ORDER_MAGIC_TRACED
+    parts = [magic, struct.pack("<I", n)]
     for (name, dt), col in zip(
         _ORDER_NUM, (action, side, kind, price, volume)
     ):
@@ -211,6 +220,8 @@ def encode_order_frame(
     parts.append(_pack_dict_column(symbols, symbol_idx))
     parts.append(_pack_dict_column(uuids, uuid_idx))
     parts.append(_pack_padded_column(oids))
+    if traces is not None:
+        parts.append(_pack_padded_column(traces))
     return b"".join(parts)
 
 
@@ -245,9 +256,12 @@ def encode_orders(orders) -> bytes:
             uuids.append(o.uuid)
         uuid_idx[i] = uuid_ix[o.uuid]
         oids.append(o.oid)
+    traces = None
+    if any(o.trace is not None for o in orders):
+        traces = [o.trace or "" for o in orders]
     return encode_order_frame(
         n, action, side, kind, price, volume, syms, sym_idx, uuids,
-        uuid_idx, oids,
+        uuid_idx, oids, traces=traces,
     )
 
 
@@ -257,10 +271,10 @@ def decode_order_frame(payload: bytes) -> dict:
     symbol_idx: u32 array; uuids, uuid_idx; oids: np 'S' array}."""
     buf = memoryview(payload)
     magic = bytes(buf[:4])
-    if magic not in (ORDER_MAGIC, ORDER_MAGIC_V1):
+    if magic not in (ORDER_MAGIC, ORDER_MAGIC_V1, ORDER_MAGIC_TRACED):
         raise ValueError("not an ORDER frame")
     read_dict = (
-        _read_dict_column if magic == ORDER_MAGIC else _read_dict_column_v1
+        _read_dict_column_v1 if magic == ORDER_MAGIC_V1 else _read_dict_column
     )
     (n,) = struct.unpack_from("<I", buf, 4)
     off = 8
@@ -271,6 +285,10 @@ def decode_order_frame(payload: bytes) -> dict:
     out["symbols"], out["symbol_idx"], off = read_dict(buf, off, n)
     out["uuids"], out["uuid_idx"], off = read_dict(buf, off, n)
     out["oids"], off = _read_padded_column(buf, off, n)
+    if magic == ORDER_MAGIC_TRACED:
+        # Per-order trace contexts ride the frame; engine code never reads
+        # this key (the consumer peels it off before processing).
+        out["trace"], off = _read_padded_column(buf, off, n)
     return out
 
 

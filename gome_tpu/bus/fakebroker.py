@@ -19,6 +19,7 @@ from collections import deque
 
 from .amqp import (
     EMPTY_TABLE,
+    FLAG_HEADERS,
     FRAME_BODY,
     FRAME_END,
     FRAME_HEADER,
@@ -32,6 +33,7 @@ from .amqp import (
     read_frame,
     read_longstr,
     read_shortstr,
+    read_table,
     shortstr,
     skip_table,
 )
@@ -40,11 +42,13 @@ from .amqp import (
 class _BrokerQueue:
     def __init__(self, name: str):
         self.name = name
-        # (body, redelivered): the redelivered flag rides Basic.Deliver so
-        # a reconnecting consumer can tell replayed deliveries from fresh
-        # ones (RabbitMQ semantics; bus.amqp.SupervisedAmqpQueue keys its
-        # exact-resume dedup on it).
-        self.pending: deque[tuple[bytes, bool]] = deque()
+        # (body, redelivered, headers): the redelivered flag rides
+        # Basic.Deliver so a reconnecting consumer can tell replayed
+        # deliveries from fresh ones (RabbitMQ semantics; bus.amqp.
+        # SupervisedAmqpQueue keys its exact-resume dedup on it); headers
+        # are the publisher's basic-properties table, preserved verbatim
+        # across delivery AND redelivery (trace propagation relies on it).
+        self.pending: deque[tuple[bytes, bool, dict | None]] = deque()
         self.consumers: list["_Connection"] = []  # round-robin order
         self.drain_lock = threading.Lock()  # one drainer at a time (FIFO)
         self._rr = 0
@@ -66,10 +70,12 @@ class _Connection:
         self.closed = False
         self.wlock = threading.Lock()
         self.dlock = threading.Lock()  # delivery-tag + unacked consistency
-        self.unacked: dict[int, tuple[str, bytes]] = {}  # tag -> (queue, body)
+        # tag -> (queue, body, headers)
+        self.unacked: dict[int, tuple[str, bytes, dict | None]] = {}
         self.consuming: list[str] = []
         self._next_tag = 1
-        self._pending_pub: tuple | None = None  # (queue, bytearray, [size])
+        # (queue, bytearray, [size], [headers])
+        self._pending_pub: tuple | None = None
         self._publishes = 0  # fault-mode accounting
         self._confirm = False  # publisher-confirm mode (Confirm.Select)
         self._pub_tag = 0  # confirm-mode ack tag sequence
@@ -78,7 +84,13 @@ class _Connection:
         with self.wlock:
             self.sock.sendall(data)
 
-    def deliver(self, queue: str, body: bytes, redelivered: bool = False) -> None:
+    def deliver(
+        self,
+        queue: str,
+        body: bytes,
+        redelivered: bool = False,
+        headers: dict | None = None,
+    ) -> None:
         # Broker threads for DIFFERENT producer connections can deliver to
         # the same consumer concurrently: tag allocation + unacked insert +
         # the send must be one atomic unit or tags duplicate and unacked
@@ -87,7 +99,7 @@ class _Connection:
         with self.dlock:
             tag = self._next_tag
             self._next_tag += 1
-            self.unacked[tag] = (queue, body)
+            self.unacked[tag] = (queue, body, headers)
             deliver = method(
                 60,
                 60,
@@ -97,7 +109,7 @@ class _Connection:
                 + shortstr(queue),
             )
             parts = [frame(FRAME_METHOD, 1, deliver)] + content_frames(
-                1, body, self.broker.frame_max
+                1, body, self.broker.frame_max, headers=headers
             )
             self.send(b"".join(parts))
 
@@ -131,6 +143,10 @@ class _Connection:
                     self._method(channel, memoryview(payload))
                 elif ftype == FRAME_HEADER and self._pending_pub:
                     (size,) = struct.unpack_from(">Q", payload, 4)
+                    (flags,) = struct.unpack_from(">H", payload, 12)
+                    if flags & FLAG_HEADERS:
+                        hdrs, _ = read_table(memoryview(payload), 14)
+                        self._pending_pub[3][0] = hdrs or None
                     self._pending_pub[2][0] = size
                     if size == 0:
                         self._finish_publish()
@@ -219,7 +235,7 @@ class _Connection:
                     )
                 )
                 return
-            self._pending_pub = (rkey, bytearray(), [0])
+            self._pending_pub = (rkey, bytearray(), [0], [None])
         elif (class_id, method_id) == (60, 20):  # Basic.Consume
             off += 2
             qname, off = read_shortstr(buf, off)
@@ -243,9 +259,9 @@ class _Connection:
         # anything else: ignore (permissive test broker)
 
     def _finish_publish(self) -> None:
-        qname, body, _ = self._pending_pub
+        qname, body, _, hdr = self._pending_pub
         self._pending_pub = None
-        self.broker._publish(qname, bytes(body))
+        self.broker._publish(qname, bytes(body), headers=hdr[0])
         if self._confirm:
             # Publisher confirm: Basic.Ack AFTER the enqueue — a killed
             # connection whose publish was dropped never acks, which is
@@ -365,10 +381,12 @@ class FakeBroker:
                 self._queues[name] = _BrokerQueue(name)
             return self._queues[name]
 
-    def _publish(self, name: str, body: bytes) -> None:
+    def _publish(
+        self, name: str, body: bytes, headers: dict | None = None
+    ) -> None:
         q = self._queue(name)
         with self._lock:
-            q.pending.append((body, False))
+            q.pending.append((body, False, headers))
         self._drain(q)
 
     def _attach_consumer(self, name: str, conn: _Connection) -> None:
@@ -392,12 +410,12 @@ class FakeBroker:
                     consumer = q.next_consumer()
                     if consumer is None:
                         return
-                    body, redelivered = q.pending.popleft()
+                    body, redelivered, headers = q.pending.popleft()
                 try:
-                    consumer.deliver(q.name, body, redelivered)
+                    consumer.deliver(q.name, body, redelivered, headers)
                 except OSError:
                     with self._lock:
-                        q.pending.appendleft((body, redelivered))
+                        q.pending.appendleft((body, redelivered, headers))
                     return
 
     def _requeue_unacked(self, conn: _Connection) -> None:
@@ -411,14 +429,15 @@ class FakeBroker:
         with conn.dlock:
             items = sorted(conn.unacked.items())
             conn.unacked.clear()
-        by_queue: dict[str, list[bytes]] = {}
-        for _tag, (qname, body) in items:
-            by_queue.setdefault(qname, []).append(body)
-        for qname, bodies in by_queue.items():
+        by_queue: dict[str, list[tuple]] = {}
+        for _tag, (qname, body, headers) in items:
+            by_queue.setdefault(qname, []).append((body, headers))
+        for qname, entries in by_queue.items():
             q = self._queue(qname)
             with self._lock:
                 q.pending.extendleft(
-                    (body, True) for body in reversed(bodies)
+                    (body, True, headers)
+                    for body, headers in reversed(entries)
                 )
             self._drain(q)
 

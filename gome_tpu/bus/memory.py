@@ -8,16 +8,20 @@ from .base import Message, Queue, _Waitable
 
 
 class MemoryQueue(_Waitable, Queue):
+    supports_headers = True  # in-process equivalent of AMQP headers
+
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
         self._items: list[bytes] = []
+        self._headers: list[dict | None] = []
         self._committed = 0
         self._init_wait()
 
-    def publish(self, body: bytes) -> int:
+    def publish(self, body: bytes, headers: dict | None = None) -> int:
         with self._lock:
             self._items.append(bytes(body))
+            self._headers.append(headers)
             off = len(self._items) - 1
         self._notify_publish()
         return off
@@ -26,7 +30,9 @@ class MemoryQueue(_Waitable, Queue):
         with self._lock:
             end = min(len(self._items), offset + max_n)
             return [
-                Message(offset=i, body=self._items[i])
+                Message(
+                    offset=i, body=self._items[i], headers=self._headers[i]
+                )
                 for i in range(offset, end)
             ]
 
@@ -66,3 +72,4 @@ class MemoryQueue(_Waitable, Queue):
                     f"{self._committed}"
                 )
             del self._items[offset:]
+            del self._headers[offset:]

@@ -163,13 +163,32 @@ class PersistConfig:
 
 @dataclasses.dataclass(frozen=True)
 class OpsConfig:
-    """Operator HTTP endpoint (/metrics Prometheus text + /healthz JSON) —
-    an extension beyond the reference (which has logging only, SURVEY
-    §5.5). Disabled unless an `ops:` section appears in config.yaml."""
+    """Operator HTTP endpoint (/metrics Prometheus text + /healthz JSON +
+    /trace Chrome trace-event dump) — an extension beyond the reference
+    (which has logging only, SURVEY §5.5). Disabled unless an `ops:`
+    section appears in config.yaml.
+
+    trace/trace_keep/slow_ms configure the order-lifecycle tracer
+    (utils.trace): with trace on, every order gets a trace id at the
+    gateway and the flight recorder keeps the last `trace_keep` complete
+    journeys plus every journey slower than `slow_ms` end to end."""
 
     host: str = "127.0.0.1"
     port: int = 9109
     enabled: bool = False
+    trace: bool = True  # arm the order-lifecycle tracer with the endpoint
+    trace_keep: int = 64  # flight-recorder ring size (journeys)
+    slow_ms: float = 50.0  # slow-order threshold (pinned in the slow ring)
+
+    def __post_init__(self):
+        if self.trace_keep <= 0:
+            raise ValueError(
+                f"ops.trace_keep must be positive, got {self.trace_keep}"
+            )
+        if self.slow_ms < 0:
+            raise ValueError(
+                f"ops.slow_ms must be >= 0, got {self.slow_ms}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

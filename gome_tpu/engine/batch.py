@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..types import KERNELS, Action, MatchResult, Order
+from ..utils.trace import TRACER
 from .book import (
     BUY,
     BookConfig,
@@ -1231,7 +1232,10 @@ class BatchEngine:
     def _one_grid_columnar(self, pending, batches):
         from .events import decode_grid_columnar
 
-        ops, meta, leftover, lane_ids = self._pack_grid_vectorized(pending)
+        with TRACER.stage("pad_pack"):
+            ops, meta, leftover, lane_ids = self._pack_grid_vectorized(
+                pending
+            )
         if len(meta["arrival"]) == 0:
             # Everything dropped (unrepresentable DELs): nothing to run.
             return leftover
@@ -1241,9 +1245,12 @@ class BatchEngine:
             (int(r), int(tt)): None for r, tt in zip(meta["row"], meta["t"])
         }
         outs, lane_overrides = self._run_exact(ops, contexts, lane_ids)
-        batches.append(
-            decode_grid_columnar(meta, splice_outs(outs, lane_overrides))
-        )
+        with TRACER.stage("decode"):
+            batches.append(
+                decode_grid_columnar(
+                    meta, splice_outs(outs, lane_overrides)
+                )
+            )
         return leftover
 
     def _one_grid(self, pending, decoded):
@@ -1304,9 +1311,15 @@ class BatchEngine:
         # grids converge in a few exact replays instead of one wildly
         # oversized jump.
         while True:
-            new_books, outs = self._step(books_before, ops, lane_ids, cap_g)
-            self.stats.device_calls += 1
-            host_flags = np.asarray(jax.device_get(outs.book_overflow))
+            # One stage span per attempt: dispatch + the blocking overflow
+            # fetch (the fetch drains the step, so this is the device
+            # wait); the annotation aligns it with jax.profiler traces.
+            with TRACER.stage("device_execute"):
+                new_books, outs = self._step(
+                    books_before, ops, lane_ids, cap_g
+                )
+                self.stats.device_calls += 1
+                host_flags = np.asarray(jax.device_get(outs.book_overflow))
             if not host_flags.any():
                 break
             counts = np.asarray(jax.device_get(books_before.count))  # [S, 2]

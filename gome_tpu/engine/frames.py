@@ -52,6 +52,7 @@ import numpy as np
 FETCH_SECONDS = 0.0
 
 from ..types import Action, OrderType
+from ..utils.trace import TRACER
 from .batch import BatchEngine, _next_pow2, _next_pow4, splice_outs
 from .book import GRID_I32_FIELDS, DeviceOp
 from .step import ACTION_ADD, LOT_MAX32
@@ -434,9 +435,11 @@ def apply_frame(eng: BatchEngine, cols: dict):
     orders. Caller guarantees admission was already applied."""
     from .events import decode_grid_columnar
 
-    a = _frame_arrays(eng, cols)
+    with TRACER.stage("pad_pack"):
+        a = _frame_arrays(eng, cols)
+        grids = pack_frame_grids(eng, a)
     batches = []
-    for ops, meta, lane_ids, cap_g in pack_frame_grids(eng, a):
+    for ops, meta, lane_ids, cap_g in grids:
         contexts = {
             (int(r), int(tt)): None for r, tt in zip(meta["row"], meta["t"])
         }
@@ -648,8 +651,9 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
     host-side errors; device budget trips surface at resolve_frame."""
     cp = eng._checkpoint()
     try:
-        a = _frame_arrays(eng, cols)
-        grids = pack_frame_grids(eng, a)
+        with TRACER.stage("pad_pack"):
+            a = _frame_arrays(eng, cols)
+            grids = pack_frame_grids(eng, a)
         books = eng.books
         items = []
         compact = None
@@ -665,13 +669,15 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
                 (max(_next_pow2(len(grids)), 8), 4), jnp.int32
             )
         for g_i, (ops, meta, lane_ids, cap_g) in enumerate(grids):
-            books, outs = eng._step(books, ops, lane_ids, cap_g)
-            eng.stats.device_calls += 1
-            n_rows, t_grid = ops.action.shape
-            fills_acc, cancels_acc, totals_acc = compact_accum(
-                eng.config, outs, fills_acc, cancels_acc, totals_acc,
-                np.int32(g_i),
-            )
+            t_disp = TRACER.clock() if TRACER.enabled else 0.0
+            with TRACER.annotation("grid_dispatch"):
+                books, outs = eng._step(books, ops, lane_ids, cap_g)
+                eng.stats.device_calls += 1
+                n_rows, t_grid = ops.action.shape
+                fills_acc, cancels_acc, totals_acc = compact_accum(
+                    eng.config, outs, fills_acc, cancels_acc, totals_acc,
+                    np.int32(g_i),
+                )
             meta["_n_rows"] = n_rows
             # The record axis K comes from the ARRAY, never from
             # config.max_fills: with cap < max_fills the step's record
@@ -685,12 +691,24 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
             # Record the full dispatch combo (grid geometry x frame
             # buffers) for shape_manifest/precompile_combos: this tuple
             # determines every jit trace the dispatch just performed.
-            eng._seen_combos.add((
+            combo = (
                 n_rows, t_grid, int(cap_g), lane_ids is not None,
                 int(meta["_m_pad"]), k_rec,
                 int(fills_acc.shape[1]), int(cancels_acc.shape[1]),
                 int(totals_acc.shape[0]),
-            ))
+            )
+            if TRACER.enabled:
+                # Dispatch cost split by whether this shape combo had
+                # been traced+compiled before: a first-seen combo pays
+                # the synchronous jit trace + XLA compile right here
+                # (dispatch itself is async), which is exactly the
+                # invisible-latency-cliff the span taxonomy calls out.
+                TRACER.observe_span(
+                    "compile_hit" if combo in eng._seen_combos
+                    else "compile_miss",
+                    t_disp, TRACER.clock(),
+                )
+            eng._seen_combos.add(combo)
         eng.books = books
         if grids:
             from .batch import _cap_ladder
@@ -749,12 +767,21 @@ def resolve_frame(eng: BatchEngine, pend: PendingFrame):
         return _assemble(eng, pend.arrays, [])
     global FETCH_SECONDS
     t0 = time.perf_counter()
-    totals_dev, fills_dev, cancels_dev = pend.compact[:3]
-    totals = jax.device_get(totals_dev)
-    counts_max = (
-        jax.device_get(pend.compact[3]) if len(pend.compact) > 3 else None
-    )
+    ts0 = TRACER.clock() if TRACER.enabled else 0.0
+    with TRACER.annotation("frame_fetch_totals"):
+        totals_dev, fills_dev, cancels_dev = pend.compact[:3]
+        totals = jax.device_get(totals_dev)
+        counts_max = (
+            jax.device_get(pend.compact[3]) if len(pend.compact) > 3
+            else None
+        )
     FETCH_SECONDS += time.perf_counter() - t0
+    if TRACER.enabled:
+        # The totals fetch is the frame's completion barrier: blocking
+        # here drains every dispatched grid, so this IS the
+        # device-execute wait. (Span clock = the tracer's, which tests
+        # may script; FETCH_SECONDS stays on perf_counter.)
+        TRACER.observe_span("device_execute", ts0, TRACER.clock())
     g = len(pend.items)
     nf_g = totals[:g, 0].astype(np.int64)
     nc_g = totals[:g, 1].astype(np.int64)
@@ -789,6 +816,7 @@ def resolve_frame(eng: BatchEngine, pend: PendingFrame):
     # Phase 2: fetch the used prefixes (pow2-bucketed, clamped to the
     # buffer) now the true counts are known.
     t0 = time.perf_counter()
+    ts0 = TRACER.clock() if TRACER.enabled else 0.0
     f_len = min(_next_pow2(max(total_f, 64)), int(fills_dev.shape[1]))
     c_len = min(_next_pow2(max(total_c, 64)), int(cancels_dev.shape[1]))
     fills_mat = jax.device_get(
@@ -798,6 +826,8 @@ def resolve_frame(eng: BatchEngine, pend: PendingFrame):
         _prefix_slice_fn(int(cancels_dev.shape[0]), c_len)(cancels_dev)
     )
     FETCH_SECONDS += time.perf_counter() - t0
+    if TRACER.enabled:
+        TRACER.observe_span("device_execute", ts0, TRACER.clock())
     # Re-anchor count_ub from this frame's true post-frame counts (the
     # pipeline resolves FIFO, so extra minus THIS frame's increments is
     # exactly the still-in-flight sum; a trip above skips this and the
@@ -808,19 +838,22 @@ def resolve_frame(eng: BatchEngine, pend: PendingFrame):
     off_f = np.concatenate(([0], np.cumsum(nf_g)))
     off_c = np.concatenate(([0], np.cumsum(nc_g)))
     batches = []
-    for i, (meta, shape) in enumerate(pend.items):
-        fills = {
-            f: fills_mat[j, off_f[i] : off_f[i + 1]]
-            for j, f in enumerate(_FILL_FIELDS)
-        }
-        cancels = {
-            f: cancels_mat[j, off_c[i] : off_c[i + 1]]
-            for j, f in enumerate(_CANCEL_FIELDS)
-        }
-        batches.append(
-            _decode_compact(eng, meta, shape, (totals[i], fills, cancels))
-        )
-    return _assemble(eng, pend.arrays, batches)
+    with TRACER.stage("decode"):
+        for i, (meta, shape) in enumerate(pend.items):
+            fills = {
+                f: fills_mat[j, off_f[i] : off_f[i + 1]]
+                for j, f in enumerate(_FILL_FIELDS)
+            }
+            cancels = {
+                f: cancels_mat[j, off_c[i] : off_c[i + 1]]
+                for j, f in enumerate(_CANCEL_FIELDS)
+            }
+            batches.append(
+                _decode_compact(
+                    eng, meta, shape, (totals[i], fills, cancels)
+                )
+            )
+        return _assemble(eng, pend.arrays, batches)
 
 
 def apply_frame_fast(eng: BatchEngine, cols: dict):
@@ -1000,6 +1033,8 @@ def orders_from_frame(cols: dict):
 
     syms, uuids = cols["symbols"], cols["uuids"]
     sidx, uidx = cols["symbol_idx"].tolist(), cols["uuid_idx"].tolist()
+    traces = cols.get("trace")  # GCO3 frames carry per-order contexts
+    traces = traces.tolist() if traces is not None else None
     out = []
     for i, (a, s, k, p, v, o) in enumerate(
         zip(
@@ -1008,11 +1043,15 @@ def orders_from_frame(cols: dict):
             cols["volume"].tolist(), cols["oids"].tolist(),
         )
     ):
+        trace = None
+        if traces is not None and traces[i]:
+            trace = traces[i].decode()
         out.append(
             Order(
                 uuid=uuids[uidx[i]], oid=o.decode(), symbol=syms[sidx[i]],
                 side=Side(int(s)), price=int(p), volume=int(v),
                 action=Action(int(a)), order_type=OrderType(int(k)),
+                trace=trace,
             )
         )
     return out

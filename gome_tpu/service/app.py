@@ -107,6 +107,18 @@ class EngineService:
         if self.config.ops.enabled:
             from .ops import OpsServer
 
+            if self.config.ops.trace:
+                # Arm the order-lifecycle tracer (utils.trace): trace ids
+                # at the gateway, per-stage histograms in /metrics, and
+                # the flight recorder behind the ops /trace endpoint.
+                from ..utils.trace import TRACER, FlightRecorder
+
+                TRACER.install(
+                    FlightRecorder(
+                        keep_n=self.config.ops.trace_keep,
+                        slow_threshold_s=self.config.ops.slow_ms / 1e3,
+                    )
+                )
             self.ops = OpsServer(
                 self, host=self.config.ops.host, port=self.config.ops.port
             )

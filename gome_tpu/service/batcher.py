@@ -34,6 +34,7 @@ service/health.py folds them into /healthz."""
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from collections import deque
@@ -42,6 +43,7 @@ from ..bus.colwire import encode_orders
 from ..types import Order
 from ..utils.logging import get_logger
 from ..utils.metrics import REGISTRY
+from ..utils.trace import TRACER, decode_context, encode_context
 
 log = get_logger("batcher")
 
@@ -180,9 +182,31 @@ class FrameBatcher:
     def _flush_locked(self) -> int:
         batch = self._swap_locked()
         if batch:
+            if TRACER.enabled:
+                batch = self._close_batch_wait(batch)
             self._spill.append(encode_orders(batch))
         self._drain_spill_locked()
         return len(batch)
+
+    @staticmethod
+    def _close_batch_wait(batch: list[Order]) -> list[Order]:
+        """Order-lifecycle tracing: each traced order's context carries
+        the gateway's enqueue timestamp — close its batch_wait span
+        (submit -> frame close) and re-stamp the context with the flush
+        time so the consumer's bus_transit span starts here. Runs only
+        while the tracer is armed; untraced orders pass through
+        untouched."""
+        now = TRACER.clock()
+        out = []
+        for o in batch:
+            if o.trace is not None:
+                tid, t0 = decode_context(o.trace)
+                TRACER.add_span(tid, "batch_wait", t0, now)
+                o = dataclasses.replace(
+                    o, trace=encode_context(tid, now)
+                )
+            out.append(o)
+        return out
 
     def _drain_spill_locked(self) -> None:
         """Publish spilled frames FIFO (oldest first — frame order on the

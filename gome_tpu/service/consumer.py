@@ -21,6 +21,7 @@ from ..engine.orchestrator import MatchEngine
 from ..utils.logging import get_logger
 from ..utils.metrics import REGISTRY
 from ..utils.resilience import BackoffPolicy, backoff_delays
+from ..utils.trace import TRACER, decode_context
 from ..utils.tracing import annotate
 
 log = get_logger("consumer")
@@ -112,9 +113,62 @@ class OrderConsumer:
         self.poison_threshold = poison_threshold
         self._fail_offset = -1
         self._fail_count = 0
+        # Order-lifecycle tracing: in-flight frames' journey ids keyed by
+        # queue offset (pipelined mode publishes/completes at resolve
+        # time, which can be several steps after the feed).
+        self._pipe_tids: dict[int, list] = {}
         self._last_step_failed = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def _consume_traces(self, cols: dict, headers) -> list:
+        """Order-lifecycle tracing, receipt side: pop the GCO3 trace
+        column off a decoded ORDER frame (the engine never sees it — its
+        admission filters would desync it from the kept rows), close each
+        traced order's bus_transit span from the context's carried
+        publish timestamp, and return the journey ids for batch-scoped
+        attribution. A headers-only context (AMQP x-trace on an opaque
+        body) traces the whole message. [] while tracing is off — the
+        column is still popped so tracing-off consumers interop with
+        tracing-on producers."""
+        raw = cols.pop("trace", None)
+        tr = TRACER
+        if not tr.enabled:
+            return []
+        t_rx = tr.clock()
+        tids = []
+        if raw is not None:
+            for ctx in raw.tolist():
+                if not ctx:
+                    continue
+                tid, t_pub = decode_context(ctx.decode())
+                tr.add_span(tid, "bus_transit", t_pub or t_rx, t_rx)
+                tids.append(tid)
+        elif headers and headers.get("x-trace"):
+            tid, t_pub = decode_context(headers["x-trace"])
+            tr.add_span(tid, "bus_transit", t_pub or t_rx, t_rx)
+            tids.append(tid)
+        return tids
+
+    def _json_traces(self, orders, msgs) -> list:
+        """bus_transit spans for a decoded JSON run: context from the
+        order body (codec Trace field), falling back to the message's
+        AMQP x-trace header (one order per JSON message)."""
+        tr = TRACER
+        if not tr.enabled:
+            return []
+        t_rx = tr.clock()
+        tids = []
+        for o, m in zip(orders, msgs):
+            ctx = o.trace
+            if ctx is None and m.headers:
+                ctx = m.headers.get("x-trace")
+            if not ctx:
+                continue
+            tid, t_pub = decode_context(ctx)
+            tr.add_span(tid, "bus_transit", t_pub or t_rx, t_rx)
+            tids.append(tid)
+        return tids
 
     def _publish(self, batch) -> None:
         # Frame publishing needs real EventBatch columns; the sharded
@@ -139,6 +193,7 @@ class OrderConsumer:
         from ..bus.colwire import decode_order_frame, is_frame
 
         n_orders = n_events = 0
+        done_tids: list = []
         with _batch_latency.time() as timer:
             # Split the poll into runs: contiguous JSON messages decode as
             # one batch (native codec); a binary ORDER frame (colwire) IS
@@ -149,21 +204,28 @@ class OrderConsumer:
                 if is_frame(msgs[i].body):
                     with annotate("engine_process_frame"):
                         cols = decode_order_frame(msgs[i].body)
-                        batch = self.engine.process_frame(cols)
+                        tids = self._consume_traces(cols, msgs[i].headers)
+                        with TRACER.batch(tids):
+                            batch = self.engine.process_frame(cols)
                         count = int(cols["n"])
-                    with annotate("publish_events"):
+                    with annotate("publish_events"), TRACER.batch(tids), \
+                            TRACER.span("publish"):
                         self._publish(batch)
+                    done_tids += tids
                     n_orders += count
                     n_events += len(batch)
                     i += 1
                 else:
-                    i, n_o, n_e = self._process_json_run(msgs, i)
+                    i, n_o, n_e, tids = self._process_json_run(msgs, i)
+                    done_tids += tids
                     n_orders += n_o
                     n_events += n_e
             # Commit only after results are published: a crash between
             # processing and commit replays the batch (at-least-once;
             # recovery dedup lives in gome_tpu.persist's replay logic).
             self.bus.order_queue.commit(msgs[-1].offset + 1)
+        for tid in done_tids:  # journeys are complete once committed
+            TRACER.complete(tid)
         _orders_total.inc(n_orders)
         _events_total.inc(n_events)
         _batch_size.observe(n_orders)
@@ -174,13 +236,14 @@ class OrderConsumer:
             self.on_batch(n_orders, n_events)
         return n_orders
 
-    def _process_json_run(self, msgs, i: int) -> tuple[int, int, int]:
+    def _process_json_run(self, msgs, i: int) -> tuple[int, int, int, list]:
         """Decode + process + publish one contiguous run of JSON messages
-        starting at msgs[i]; returns (j, n_orders, n_events) with j the
-        first index past the run. The CALLER commits — commit policy
-        differs between the synchronous and pipelined paths. Columnar path
-        end to end: events stay as numpy columns from decode through wire
-        serialization; no per-event Python objects on the hot path."""
+        starting at msgs[i]; returns (j, n_orders, n_events, trace_ids)
+        with j the first index past the run. The CALLER commits — commit
+        policy differs between the synchronous and pipelined paths — and
+        completes the returned journeys. Columnar path end to end: events
+        stay as numpy columns from decode through wire serialization; no
+        per-event Python objects on the hot path."""
         from ..bus.colwire import is_frame
 
         j = i
@@ -188,11 +251,13 @@ class OrderConsumer:
             j += 1
         with annotate("decode_orders"):
             orders = decode_orders_batch([m.body for m in msgs[i:j]])
-        with annotate("engine_process"):
+        tids = self._json_traces(orders, msgs[i:j])
+        with annotate("engine_process"), TRACER.batch(tids):
             batch = self.engine.process_columnar(orders)
-        with annotate("publish_events"):
+        with annotate("publish_events"), TRACER.batch(tids), \
+                TRACER.span("publish"):
             self._publish(batch)
-        return j, len(orders), len(batch)
+        return j, len(orders), len(batch), tids
 
     def _emit_resolved(self, token, batch) -> int:
         """Publish one resolved frame's events and commit ITS offset —
@@ -203,10 +268,14 @@ class OrderConsumer:
         accumulate and the hook fires at the next pipeline-empty boundary
         (a consistent cut)."""
         offset, n = token
-        with annotate("publish_events"):
+        tids = self._pipe_tids.pop(offset, None) or []
+        with annotate("publish_events"), TRACER.batch(tids), \
+                TRACER.span("publish"):
             self._publish(batch)
         self.bus.order_queue.commit(offset + 1)
         self._account(n, len(batch))
+        for tid in tids:
+            TRACER.complete(tid)
         return n
 
     def _account(self, n_orders: int, n_events: int) -> None:
@@ -258,7 +327,10 @@ class OrderConsumer:
                     m = msgs[i]
                     if is_frame(m.body):
                         cols = decode_order_frame(m.body)
-                        with annotate("pipeline_feed"):
+                        tids = self._consume_traces(cols, m.headers)
+                        if tids:
+                            self._pipe_tids[m.offset] = tids
+                        with annotate("pipeline_feed"), TRACER.batch(tids):
                             resolved = pipe.feed(
                                 cols, token=(m.offset, int(cols["n"]))
                             )
@@ -271,10 +343,12 @@ class OrderConsumer:
                             if out is None:
                                 break
                             n_orders += self._emit_resolved(*out)
-                        j, n_o, n_e = self._process_json_run(msgs, i)
+                        j, n_o, n_e, jtids = self._process_json_run(msgs, i)
                         q.commit(msgs[j - 1].offset + 1)
                         n_orders += n_o
                         self._account(n_o, n_e)
+                        for tid in jtids:
+                            TRACER.complete(tid)
                         i = j
         except Exception:
             # feed/resolve already restored their own frames' state; abort
@@ -283,6 +357,10 @@ class OrderConsumer:
             # quarantine) so the replay from the committed offset sees a
             # consistent engine.
             pipe.abort()
+            # The replay re-feeds the aborted frames and re-records their
+            # journeys' consumer-side spans; stale id->offset entries
+            # would mis-attribute the replay's publishes.
+            self._pipe_tids.clear()
             raise
         if n_orders and timer.elapsed > 0:
             inst = n_orders / timer.elapsed
@@ -357,6 +435,7 @@ class OrderConsumer:
                     # rewound, marks restored).
                     if self._pipe is not None:
                         self._pipe.abort()
+                        self._pipe_tids.clear()
                     return self.quarantine_once()
             except Exception:
                 log.exception("poison-batch policy step failed; will retry")

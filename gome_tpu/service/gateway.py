@@ -16,6 +16,7 @@ Differences, deliberate:
 
 from __future__ import annotations
 
+import dataclasses
 from concurrent import futures
 
 import grpc
@@ -27,6 +28,7 @@ from ..config import Config
 from ..fixed import scale
 from ..types import Action, Order, OrderType, Side
 from ..utils.logging import get_logger
+from ..utils.trace import TRACER, encode_context
 
 log = get_logger("gateway")
 
@@ -92,8 +94,39 @@ class OrderGateway:
     def _emit(self, order: Order) -> None:
         if self._batcher is not None:
             self._batcher.submit(order)
+        elif order.trace is not None and self._bus.order_queue.supports_headers:
+            # Per-order publish: the trace context also rides the AMQP
+            # basic-properties headers (survives the broker hop even for
+            # opaque bodies; the consumer adopts it when the body carries
+            # none).
+            self._bus.order_queue.publish(
+                encode_order(order), headers={"x-trace": order.trace}
+            )
         else:
             self._bus.order_queue.publish(encode_order(order))
+
+    def _begin_trace(self):
+        """(trace_id, t_ingress) for a new order journey, or (None, 0.0)
+        while tracing is disabled (the zero-overhead path)."""
+        tid = TRACER.new_trace()
+        return tid, (TRACER.clock() if tid is not None else 0.0)
+
+    def _traced_emit(self, order: Order, tid: str | None, t0: float) -> Order:
+        """Close the ingress span, stamp the wire context, and emit under
+        an enqueue span. Returns the (possibly re-stamped) order."""
+        if tid is None:
+            self._emit(order)
+            return order
+        TRACER.add_span(tid, "ingress", t0, TRACER.clock())
+        with TRACER.bind(tid), TRACER.span("enqueue", tid):
+            # The hop timestamp is stamped INSIDE the enqueue span: the
+            # receiver-side span it seeds (batch_wait / bus_transit)
+            # then starts after enqueue began — journeys stay monotone.
+            order = dataclasses.replace(
+                order, trace=TRACER.context(tid)
+            )
+            self._emit(order)
+        return order
 
     def _validate_add(self, request: pb.OrderRequest) -> Order:
         """OrderRequest -> admitted ADD Order; raises ValueError with the
@@ -111,13 +144,14 @@ class OrderGateway:
         return order
 
     def DoOrder(self, request: pb.OrderRequest, context) -> pb.OrderResponse:
+        tid, t0 = self._begin_trace()
         try:
             order = self._validate_add(request)
         except ValueError as e:
             return pb.OrderResponse(code=3, message=f"rejected: {e}")
         self._mark(order)  # pre-pool before queueing (main.go:44-45)
         try:
-            self._emit(order)
+            self._traced_emit(order, tid, t0)
         except (ConnectionError, OSError) as e:
             # Bus degraded (spill full / circuit open / reconnect budget
             # exhausted): the order was NOT accepted into the pipeline, so
@@ -137,6 +171,7 @@ class OrderGateway:
         return pb.OrderResponse(code=0, message="order accepted")
 
     def DeleteOrder(self, request: pb.OrderRequest, context) -> pb.OrderResponse:
+        tid, t0 = self._begin_trace()
         try:
             order = order_from_request(request, Action.DEL, self._accuracy)
         except ValueError as e:
@@ -145,7 +180,7 @@ class OrderGateway:
         # still-queued ADD dies (engine.go:88-90, SURVEY §2.3.3). Cancels
         # ride the same batcher so the DEL-after-ADD order is preserved.
         try:
-            self._emit(order)
+            self._traced_emit(order, tid, t0)
         except (ConnectionError, OSError) as e:
             return pb.OrderResponse(
                 code=CODE_RETRYABLE, message=f"degraded, retry: {e}"
@@ -170,6 +205,7 @@ class OrderGateway:
         resp = pb.OrderBatchResponse()
         accepted = 0
         for i, (request, is_cancel) in enumerate(entries):
+            tid, t0 = self._begin_trace()  # per-entry order journey
             if is_cancel:
                 try:
                     order = order_from_request(
@@ -190,7 +226,7 @@ class OrderGateway:
                 self._mark(order)
                 unmark_on_fail = True
             try:
-                self._emit(order)
+                self._traced_emit(order, tid, t0)
             except (RuntimeError, ConnectionError, OSError) as e:
                 if unmark_on_fail:
                     self._unmark(order)

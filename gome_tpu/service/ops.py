@@ -1,5 +1,7 @@
 """Operator HTTP endpoint: /metrics (Prometheus text format from
-utils.metrics.REGISTRY) and /healthz (service.health.HealthMonitor JSON).
+utils.metrics.REGISTRY), /healthz (service.health.HealthMonitor JSON), and
+/trace (the order-lifecycle flight recorder as Chrome trace-event JSON —
+load the dump in chrome://tracing or https://ui.perfetto.dev).
 
 The reference has no observability surface at all (SURVEY §5.5 — logging
 only); this is the cheap operator-facing extension the TPU service ships:
@@ -7,6 +9,7 @@ one stdlib ThreadingHTTPServer, no dependencies, curl-able:
 
     curl localhost:9109/metrics
     curl localhost:9109/healthz     # 200 healthy / 503 unhealthy
+    curl localhost:9109/trace > trace.json   # open in Perfetto
 
 Enabled by an `ops:` section in config.yaml (port, host) or by
 constructing OpsServer directly around any EngineService.
@@ -31,11 +34,14 @@ class OpsServer:
     (the bound port is in `self.port`)."""
 
     def __init__(self, service=None, host: str = "127.0.0.1", port: int = 0,
-                 registry=REGISTRY):
+                 registry=REGISTRY, tracer=None):
+        from ..utils.trace import TRACER
+
         self.service = service
         self.host = host
         self.port = port
         self.registry = registry
+        self.tracer = tracer or TRACER  # /trace reads its flight recorder
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self.monitor = None
@@ -81,6 +87,15 @@ class OpsServer:
                             200 if health.healthy else 503, body,
                             "application/json",
                         )
+                    elif self.path.split("?")[0] == "/trace":
+                        rec = ops.tracer.recorder
+                        dump = (
+                            rec.chrome_trace()
+                            if rec is not None
+                            else {"traceEvents": []}
+                        )
+                        body = json.dumps(dump).encode()
+                        self._send(200, body, "application/json")
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except Exception:  # never kill the handler thread

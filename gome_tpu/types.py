@@ -10,7 +10,7 @@ reference's float64-on-scaled-values model (SURVEY §2.2).
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, replace
+from dataclasses import dataclass, field, replace
 
 
 # Device execution strategies for one [S, T] op grid (single source of
@@ -62,6 +62,11 @@ class Order:
     volume: int  # scaled lots
     action: Action = Action.ADD
     order_type: OrderType = OrderType.LIMIT
+    # Order-lifecycle trace context (utils.trace encode_context wire form,
+    # "<id>@<t>"). None when tracing is off; excluded from equality so a
+    # traced order still compares equal to its untraced twin (replay,
+    # oracle parity).
+    trace: str | None = field(default=None, compare=False, repr=False)
 
     def with_volume(self, volume: int) -> "Order":
         return replace(self, volume=volume)

@@ -5,6 +5,12 @@ The reference has no metrics at all (SURVEY §5.5 — logging only); the
 BASELINE.json throughput metric (orders/sec matched across N symbols) needs
 first-class instrumentation. Kept dependency-free and cheap: a metric update
 is a dict lookup + add under a lock shared per-registry.
+
+Labeled series: `counter(name, labels={"stage": "ingress"})` returns one
+child of a FAMILY registered under `name` — every child renders into the
+same exposition family (`name{stage="ingress"} 3`), which is how per-stage
+/ per-symbol series avoid the `stage_x_latency` name-mangling a flat
+registry forces. A name is either flat or a family, never both.
 """
 
 from __future__ import annotations
@@ -14,21 +20,53 @@ import threading
 import time
 
 
+def _label_str(labels: dict | None, extra: dict | None = None) -> str:
+    """'{k="v",...}' with sorted keys (deterministic exposition), or ''.
+    `extra` pairs (e.g. histogram `le`) render after the sorted labels,
+    matching Prometheus convention."""
+    items = sorted((labels or {}).items())
+    if extra:
+        items += list(extra.items())
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+
+
 class Registry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._metrics: dict[str, object] = {}
 
-    def counter(self, name: str, help: str = "") -> "Counter":
-        return self._get(name, lambda: Counter(name, help))
+    def counter(
+        self, name: str, help: str = "", labels: dict | None = None
+    ) -> "Counter":
+        if labels is None:
+            return self._get(name, lambda: Counter(name, help))
+        fam = self._family(name, help, "counter", lambda lb: Counter(name, help, labels=lb))
+        return fam.child(labels)
 
-    def gauge(self, name: str, help: str = "") -> "Gauge":
-        return self._get(name, lambda: Gauge(name, help))
+    def gauge(
+        self, name: str, help: str = "", labels: dict | None = None
+    ) -> "Gauge":
+        if labels is None:
+            return self._get(name, lambda: Gauge(name, help))
+        fam = self._family(name, help, "gauge", lambda lb: Gauge(name, help, labels=lb))
+        return fam.child(labels)
 
     def histogram(
-        self, name: str, help: str = "", buckets: tuple = None
+        self,
+        name: str,
+        help: str = "",
+        buckets: tuple = None,
+        labels: dict | None = None,
     ) -> "Histogram":
-        return self._get(name, lambda: Histogram(name, help, buckets))
+        if labels is None:
+            return self._get(name, lambda: Histogram(name, help, buckets))
+        fam = self._family(
+            name, help, "histogram",
+            lambda lb: Histogram(name, help, buckets, labels=lb),
+        )
+        return fam.child(labels)
 
     def callback_gauge(self, name: str, help: str, fn) -> "CallbackGauge":
         """A gauge whose value is read from `fn()` at scrape time — for
@@ -47,6 +85,15 @@ class Registry:
                 m = self._metrics[name] = factory()
             return m
 
+    def _family(self, name, help, typ, child_factory) -> "Family":
+        fam = self._get(name, lambda: Family(name, help, typ, child_factory))
+        if not isinstance(fam, Family):
+            raise ValueError(
+                f"metric {name!r} is already registered WITHOUT labels; a "
+                "name is either a flat metric or a labeled family, not both"
+            )
+        return fam
+
     def render(self) -> str:
         """Prometheus text-format-ish exposition of every metric."""
         with self._lock:
@@ -60,10 +107,53 @@ class Registry:
             }
 
 
-class Counter:
-    def __init__(self, name: str, help: str = ""):
+class Family:
+    """All children of one labeled metric name: one HELP/TYPE header, one
+    sample block per label set. child() is get-or-create keyed by the
+    sorted label items, so re-registering the same labels returns the
+    SAME child (modules grab their series at import time, tests rebuild
+    components — both must land on one series)."""
+
+    def __init__(self, name: str, help: str, typ: str, child_factory):
         self.name = name
         self.help = help
+        self.typ = typ
+        self._factory = child_factory
+        self._children: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def child(self, labels: dict):
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._lock:
+            c = self._children.get(key)
+            if c is None:
+                c = self._children[key] = self._factory(dict(key))
+            return c
+
+    def children(self) -> list:
+        with self._lock:
+            return list(self._children.values())
+
+    def value(self) -> dict:
+        return {
+            _label_str(c.labels): c.value() for c in self.children()
+        }
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.typ}",
+        ]
+        for c in self.children():
+            lines.extend(c.render_samples())
+        return "\n".join(lines)
+
+
+class Counter:
+    def __init__(self, name: str, help: str = "", labels: dict | None = None):
+        self.name = name
+        self.help = help
+        self.labels = labels
         self._v = 0
         self._lock = threading.Lock()
 
@@ -75,17 +165,21 @@ class Counter:
         with self._lock:
             return self._v
 
+    def render_samples(self) -> list[str]:
+        return [f"{self.name}{_label_str(self.labels)} {self.value()}"]
+
     def render(self) -> str:
         return (
             f"# HELP {self.name} {self.help}\n# TYPE {self.name} counter\n"
-            f"{self.name} {self.value()}"
+            + "\n".join(self.render_samples())
         )
 
 
 class Gauge:
-    def __init__(self, name: str, help: str = ""):
+    def __init__(self, name: str, help: str = "", labels: dict | None = None):
         self.name = name
         self.help = help
+        self.labels = labels
         self._v = 0.0
         self._lock = threading.Lock()
 
@@ -97,10 +191,13 @@ class Gauge:
         with self._lock:
             return self._v
 
+    def render_samples(self) -> list[str]:
+        return [f"{self.name}{_label_str(self.labels)} {self.value()}"]
+
     def render(self) -> str:
         return (
             f"# HELP {self.name} {self.help}\n# TYPE {self.name} gauge\n"
-            f"{self.name} {self.value()}"
+            + "\n".join(self.render_samples())
         )
 
 
@@ -112,6 +209,7 @@ class CallbackGauge:
     def __init__(self, name: str, help: str, fn):
         self.name = name
         self.help = help
+        self.labels = None
         self._fn = fn
 
     def value(self):
@@ -120,10 +218,13 @@ class CallbackGauge:
         except Exception:
             return 0.0
 
+    def render_samples(self) -> list[str]:
+        return [f"{self.name} {self.value()}"]
+
     def render(self) -> str:
         return (
             f"# HELP {self.name} {self.help}\n# TYPE {self.name} gauge\n"
-            f"{self.name} {self.value()}"
+            + "\n".join(self.render_samples())
         )
 
 
@@ -137,9 +238,16 @@ class Histogram:
     """Fixed-bucket histogram (seconds by convention) with quantile
     estimation by linear interpolation inside the winning bucket."""
 
-    def __init__(self, name: str, help: str = "", buckets: tuple = None):
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        buckets: tuple = None,
+        labels: dict | None = None,
+    ):
         self.name = name
         self.help = help
+        self.labels = labels
         self.buckets = tuple(buckets or _DEFAULT_BUCKETS)
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
@@ -189,7 +297,7 @@ class Histogram:
             cum += c
         return self.buckets[-1] * 2
 
-    def render(self) -> str:
+    def render_samples(self) -> list[str]:
         # counts/sum/n must come from ONE lock acquisition: a concurrent
         # observe between reads would make the +Inf line smaller than a
         # finite bucket's cumulative count (invalid Prometheus data).
@@ -197,17 +305,25 @@ class Histogram:
             counts = list(self._counts)
             total = self._n
             total_sum = self._sum
+        lines = []
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            ls = _label_str(self.labels, {"le": b})
+            lines.append(f"{self.name}_bucket{ls} {cum}")
+        ls = _label_str(self.labels, {"le": "+Inf"})
+        lines.append(f"{self.name}_bucket{ls} {total}")
+        base = _label_str(self.labels)
+        lines.append(f"{self.name}_sum{base} {total_sum}")
+        lines.append(f"{self.name}_count{base} {total}")
+        return lines
+
+    def render(self) -> str:
         lines = [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} histogram",
         ]
-        cum = 0
-        for b, c in zip(self.buckets, counts):
-            cum += c
-            lines.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
-        lines.append(f"{self.name}_sum {total_sum}")
-        lines.append(f"{self.name}_count {total}")
+        lines.extend(self.render_samples())
         return "\n".join(lines)
 
 

@@ -1,0 +1,87 @@
+"""Observability snapshot: run a small traced order drill through the
+in-process service stack and dump the two operator surfaces to files —
+
+  <out_dir>/metrics.txt   the /metrics Prometheus exposition (per-stage
+                          gome_stage_seconds histograms included)
+  <out_dir>/trace.json    one flight-recorder dump as Chrome trace-event
+                          JSON (load in chrome://tracing or Perfetto)
+
+    python scripts/obs_snapshot.py [out_dir=obs-artifacts]
+
+CI (tier1.yml) uploads both as build artifacts after the test run, so
+every push records what the pipeline's observability surfaces actually
+look like — and a broken exposition/dump fails the step loudly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(out_dir: str = "obs-artifacts") -> int:
+    from gome_tpu.api import order_pb2 as pb
+    from gome_tpu.config import Config, EngineConfig, OpsConfig
+    from gome_tpu.service.app import EngineService
+    from gome_tpu.utils.metrics import REGISTRY
+    from gome_tpu.utils.trace import TRACER
+
+    os.makedirs(out_dir, exist_ok=True)
+    cfg = Config(
+        engine=EngineConfig(cap=32, n_slots=16, max_t=8, dtype="int32"),
+        # ops.enabled arms the order-lifecycle tracer (app wiring); the
+        # HTTP server itself is not started — we snapshot in-process.
+        ops=OpsConfig(enabled=True, trace=True, trace_keep=32),
+    )
+    svc = EngineService(cfg)
+    # A handful of crossing + cancelled orders so every surface has data:
+    # fills, a cancel notice, and complete ingress->publish journeys.
+    for i in range(8):
+        side = pb.SALE if i % 2 == 0 else pb.BUY
+        r = svc.gateway.DoOrder(
+            pb.OrderRequest(
+                uuid=f"u{i}", oid=f"o{i}", symbol="eth2usdt",
+                transaction=side, price=1.0, volume=2.0,
+            ),
+            None,
+        )
+        assert r.code == 0, r
+    svc.gateway.DeleteOrder(
+        pb.OrderRequest(
+            uuid="u6", oid="o6", symbol="eth2usdt",
+            transaction=pb.SALE, price=1.0, volume=2.0,
+        ),
+        None,
+    )
+    svc.pump()
+
+    metrics = REGISTRY.render()
+    assert "gome_stage_seconds" in metrics, "stage histograms missing"
+    with open(os.path.join(out_dir, "metrics.txt"), "w") as f:
+        f.write(metrics)
+
+    dump = TRACER.recorder.chrome_trace()
+    assert dump["traceEvents"], "flight recorder captured no journeys"
+    with open(os.path.join(out_dir, "trace.json"), "w") as f:
+        json.dump(dump, f, indent=1)
+
+    journeys = {
+        ev["args"]["trace_id"]
+        for ev in dump["traceEvents"]
+        if ev.get("ph") == "X"
+    }
+    print(
+        f"wrote {out_dir}/metrics.txt ({len(metrics)} bytes) and "
+        f"{out_dir}/trace.json ({len(dump['traceEvents'])} events, "
+        f"{len(journeys)} journeys)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "obs-artifacts"))
