@@ -508,7 +508,11 @@ class Supervised:
                         self._fault_locked(e)
                 if not retry_op or attempt + 1 >= attempts:
                     raise
-                self.retries_total += 1
+                with self._lock:
+                    # Unlocked, concurrent callers' += lost updates (the
+                    # read-modify-write interleaves); snapshot() reads it
+                    # under the lock and deserves the true count.
+                    self.retries_total += 1
                 self._c_retries.inc()
                 continue
             self.breaker.record_success()

@@ -258,3 +258,74 @@ def test_supervised_metrics_exported():
     assert "gome_conn_breaker_state_t_metrics" in text
     assert "gome_conn_reconnects_total_t_metrics" in text
     sup.close()
+
+
+def test_supervised_retry_count_mutates_under_lock():
+    """Regression (found by gomelint GL401): Supervised.call() bumped
+    retries_total OUTSIDE self._lock — a read-modify-write racing every
+    concurrent caller (lost updates), while snapshot() reads the counter
+    under the lock expecting the true value. The instrumentation below is
+    deterministic: an owner-tracking lock + a __setattr__ probe raise at
+    the exact off-lock write, instead of hoping a thread hammer happens
+    to interleave."""
+    import threading
+
+    class OwnedRLock:
+        def __init__(self):
+            self._rlock = threading.RLock()
+            self._owner = None
+            self._depth = 0
+
+        def acquire(self, blocking=True, timeout=-1):
+            got = self._rlock.acquire(blocking, timeout)
+            if got:
+                self._owner = threading.get_ident()
+                self._depth += 1
+            return got
+
+        def release(self):
+            self._depth -= 1
+            if self._depth == 0:
+                self._owner = None
+            self._rlock.release()
+
+        def __enter__(self):
+            self.acquire()
+            return self
+
+        def __exit__(self, *exc):
+            self.release()
+            return False
+
+        def held_by_me(self):
+            return self._owner == threading.get_ident()
+
+    conns = []
+
+    def factory():
+        c = FlakyConn()
+        conns.append(c)
+        return c
+
+    sup = _sup("t:retry-lock", factory)
+    lock = OwnedRLock()
+    object.__setattr__(sup, "_lock", lock)
+
+    violations = []
+
+    class Probe(type(sup)):
+        def __setattr__(self, name, value):
+            if name == "retries_total" and not lock.held_by_me():
+                violations.append(name)
+            super().__setattr__(name, value)
+
+    object.__setattr__(sup, "__class__", Probe)
+
+    first = sup.get()
+    first.fail_ops = 1  # one fault -> one reconnect -> one retry
+    assert sup.call(lambda c: c.op()) == "ok"
+    assert sup.retries_total == 1
+    assert violations == [], (
+        f"retries_total written off-lock {len(violations)} time(s)"
+    )
+    sup.close()
