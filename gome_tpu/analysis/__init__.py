@@ -1,0 +1,47 @@
+"""gomelint — domain-specific static analysis for the matching engine.
+
+The engine's correctness contracts are mostly *implicit* in dynamic
+behavior: the int32 price/volume envelope only trips when a soak test
+overflows it, a host-Python leak inside a jitted function only trips when
+a new shape traces, a compile-cache bypass only shows up as a latency
+cliff in production, and an unguarded shared attribute only loses an
+update under the exact interleaving the test suite never schedules. This
+package checks those contracts *statically*, before a soak test runs:
+
+  GL1xx  trace-safety      — host-Python leaks in jit/pallas-reachable code
+                             (analysis.trace_safety)
+  GL2xx  int32-envelope    — abstract-eval (jaxpr) dtype-envelope audit of
+                             the engine step/batch/frame/kernel graphs
+                             (analysis.envelope)
+  GL3xx  recompile-hazard  — jit wrappers that bypass the compile cache
+                             (analysis.recompile)
+  GL4xx  lock-discipline   — `# guarded by self._lock` annotations enforced
+                             lexically (analysis.locks); the opt-in runtime
+                             assertion mode lives in analysis.runtime
+
+Run it via ``python scripts/gomelint.py gome_tpu`` (CI's analysis job) or
+programmatically through :func:`run_paths`. Findings carry stable rule
+ids and ``file:line`` anchors; suppress one line with a trailing
+``# gomelint: disable=GL101`` comment, or a whole file with
+``# gomelint: disable-file=GL101`` on any line (see analysis.core).
+"""
+
+from __future__ import annotations
+
+from .core import (
+    ALL_RULES,
+    Finding,
+    SourceModule,
+    rule_catalogue,
+    run_paths,
+    run_source,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "SourceModule",
+    "rule_catalogue",
+    "run_paths",
+    "run_source",
+]

@@ -1,0 +1,187 @@
+"""gomelint core: findings, rule registry, suppressions, and the runner.
+
+A *checker* is a function ``check(module: SourceModule) -> list[Finding]``
+registered in :data:`CHECKERS`. Checkers are pure AST passes; the jaxpr
+(abstract-eval) envelope checks are driven separately by the CLI because
+they need to import and trace the engine (analysis.envelope).
+
+Suppression syntax (mirrors the familiar ``# noqa`` shape but namespaced,
+so ruff/flake8 never eat our directives and vice versa):
+
+  * line:  ``x = float(v)  # gomelint: disable=GL101`` — suppresses the
+           listed rules (comma-separated) on that physical line; ``all``
+           suppresses every rule. The justification convention is a
+           trailing `` — why`` clause after the rule list.
+  * file:  ``# gomelint: disable-file=GL104`` anywhere in the file.
+
+Suppressed findings are dropped at collection time; ``--show-suppressed``
+in the CLI resurfaces them for audits.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+#: rule id -> one-line description (the catalogue; checkers register into
+#: this at import time so the CLI's --list-rules stays complete).
+ALL_RULES: dict[str, str] = {}
+
+
+def register_rules(rules: dict[str, str]) -> None:
+    ALL_RULES.update(rules)
+
+
+def rule_catalogue() -> dict[str, str]:
+    return dict(sorted(ALL_RULES.items()))
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str  # stable id, e.g. "GL101"
+    path: str  # file path as given to the runner
+    line: int  # 1-based
+    col: int  # 0-based
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+_DIRECTIVE = re.compile(r"#\s*gomelint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\s]+)")
+
+
+def _parse_rules(blob: str) -> set[str]:
+    return {r.strip().upper() for r in blob.split(",") if r.strip()}
+
+
+class SourceModule:
+    """One parsed source file plus its suppression tables."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.line_disable: dict[int, set[str]] = {}
+        self.file_disable: set[str] = set()
+        for i, line in enumerate(self.lines, 1):
+            m = _DIRECTIVE.search(line)
+            if not m:
+                continue
+            rules = _parse_rules(m.group(2))
+            if m.group(1) == "disable-file":
+                self.file_disable |= rules
+            else:
+                self.line_disable.setdefault(i, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rule = rule.upper()
+        for table in (self.file_disable, self.line_disable.get(line, ())):
+            if rule in table or "ALL" in table:
+                return True
+        return False
+
+    # -- comment helpers (annotation-driven checkers) ----------------------
+    def line_comment(self, line: int) -> str:
+        """The comment tail of one physical line ('' when none). A '#'
+        inside a string literal can false-positive here; annotation
+        directives are short ASCII tails, so in practice the regexes the
+        checkers apply to this are unambiguous."""
+        if not 1 <= line <= len(self.lines):
+            return ""
+        text = self.lines[line - 1]
+        idx = text.find("#")
+        return text[idx:] if idx >= 0 else ""
+
+
+#: registered checkers: (family, fn). Family is the id prefix ("GL1") used
+#: by --select; fn(module) -> findings.
+CHECKERS: list[tuple[str, object]] = []
+
+
+def register_checker(family: str, fn) -> None:
+    CHECKERS.append((family, fn))
+
+
+def _selected(rule: str, select: set[str] | None) -> bool:
+    if not select:
+        return True
+    return any(rule.upper().startswith(s) for s in select)
+
+
+def _collect(module: SourceModule, select: set[str] | None,
+             keep_suppressed: bool = False) -> list[Finding]:
+    out: list[Finding] = []
+    for family, fn in CHECKERS:
+        if select and not any(s.startswith(family) or family.startswith(s)
+                              for s in select):
+            continue
+        for f in fn(module):
+            if not _selected(f.rule, select):
+                continue
+            if not keep_suppressed and module.suppressed(f.rule, f.line):
+                continue
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def _ensure_checkers_loaded() -> None:
+    # Import-time registration; local imports avoid a hard cycle.
+    from . import locks, recompile, trace_safety  # noqa: F401
+
+
+def run_source(text: str, path: str = "<memory>",
+               select: set[str] | None = None,
+               keep_suppressed: bool = False) -> list[Finding]:
+    """Analyze one source string (golden-fixture tests use this)."""
+    _ensure_checkers_loaded()
+    sel = {s.upper() for s in select} if select else None
+    return _collect(SourceModule(path, text), sel, keep_suppressed)
+
+
+def iter_python_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d not in ("__pycache__", ".git", ".ruff_cache")
+            )
+            for name in sorted(files):
+                if name.endswith(".py") and name != "order_pb2.py":
+                    # order_pb2 is protoc output; generated code answers to
+                    # protoc, not to this linter.
+                    out.append(os.path.join(root, name))
+    return out
+
+
+def run_paths(paths: list[str], select: set[str] | None = None,
+              keep_suppressed: bool = False) -> list[Finding]:
+    """Analyze files/directories; returns sorted findings."""
+    _ensure_checkers_loaded()
+    sel = {s.upper() for s in select} if select else None
+    findings: list[Finding] = []
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        try:
+            module = SourceModule(path, text)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "GL000", path, e.lineno or 1, e.offset or 0,
+                f"syntax error: {e.msg}",
+            ))
+            continue
+        findings.extend(_collect(module, sel, keep_suppressed))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+register_rules({"GL000": "file does not parse (syntax error)"})

@@ -1,0 +1,167 @@
+"""GL3xx recompile-hazard: jit wrappers that bypass the compile cache.
+
+The engine's dispatch cost model assumes every (shape, cap-class) combo
+traces ONCE — `BatchEngine._seen_combos` records what has compiled, and
+`precompile_combos` replays the manifest so live traffic never pays a
+mid-stream trace. All of that is defeated by Python patterns that mint a
+*fresh* jit wrapper (or a fresh closure identity) per call: each wrapper
+has its own trace cache, so the ~0.3-1s host trace cost comes back as an
+invisible per-call latency cliff. The rules:
+
+  GL301  `@jax.jit` def nested inside a function that is not an
+         `functools.lru_cache`/`functools.cache` factory — every call of
+         the enclosing function builds (and traces) a brand-new callable.
+         The sanctioned idiom is the cached factory
+         (`engine/frames.py:_scatter_grid_fn`).
+  GL302  `jax.jit(f)(...)` called immediately inside a function body —
+         the wrapper is born, traced, and discarded per call.
+  GL303  a list/dict/set literal passed in a static position of a jit
+         call (static args must be hashable; this raises at call time —
+         or, for the dict-in-closure variant, silently keys the cache on
+         object identity).
+  GL304  `@jax.jit` on an instance method (`self` is hashed by object
+         identity: every instance re-traces, and the cache pins the
+         instance alive) — use a free function over explicit arrays, or
+         `functools.partial(jax.jit, static_argnums=0)` over a frozen
+         config like `engine/step.py`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, register_checker, register_rules
+from .trace_safety import _dotted, _is_jit_expr, _is_partial, _jit_spec
+
+register_rules({
+    "GL301": "@jax.jit def inside an uncached factory re-traces per call",
+    "GL302": "jax.jit(f)(...) immediate call mints a fresh trace cache",
+    "GL303": "unhashable literal in a static argument position of a jit call",
+    "GL304": "@jax.jit on an instance method keys the cache on `self` identity",
+})
+
+
+def _is_cached_factory(node) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        d = _dotted(target) or ""
+        if d.rsplit(".", 1)[-1] in ("lru_cache", "cache"):
+            return True
+    return False
+
+
+def _jit_decorated(node) -> bool:
+    return any(_jit_spec(dec)[2] for dec in node.decorator_list)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, module):
+        self.module = module
+        self.findings: list[Finding] = []
+        # stack of (function node, is_cached_factory)
+        self._stack: list[tuple[ast.AST, bool]] = []
+        self._cls_depth = 0
+
+    def _report(self, rule: str, node, msg: str) -> None:
+        self.findings.append(Finding(
+            rule, self.module.path, node.lineno, node.col_offset, msg))
+
+    def visit_ClassDef(self, node):
+        self._cls_depth += 1
+        stack, self._stack = self._stack, []
+        self.generic_visit(node)
+        self._stack = stack
+        self._cls_depth -= 1
+
+    def _visit_func(self, node):
+        if _jit_decorated(node):
+            in_func = bool(self._stack)
+            if in_func and not any(c for _, c in self._stack):
+                self._report(
+                    "GL301", node,
+                    f"`@jax.jit` def {node.name}() nested in an uncached "
+                    "function: a fresh wrapper (and trace) per enclosing "
+                    "call — wrap the factory in functools.lru_cache "
+                    "(engine/frames.py:_scatter_grid_fn is the idiom)",
+                )
+            params = node.args.posonlyargs + node.args.args
+            if not in_func and self._cls_depth and params and \
+                    params[0].arg in ("self", "cls"):
+                self._report(
+                    "GL304", node,
+                    f"`@jax.jit` on method {node.name}(): the cache keys on "
+                    "`self` identity — every instance re-traces and is "
+                    "pinned alive by the cache",
+                )
+        self._stack.append((node, _is_cached_factory(node)))
+        cls_depth, self._cls_depth = self._cls_depth, 0
+        self.generic_visit(node)
+        self._cls_depth = cls_depth
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_func(node)
+
+    def visit_Call(self, node):
+        # GL302: (jax.jit(...))(args) inside a function body
+        if self._stack and isinstance(node.func, ast.Call):
+            inner = node.func
+            if _is_jit_expr(inner.func) and inner.args:
+                # jit(f)(x): exempt the module-scope wrapper-def idiom
+                # (we are inside a function here by construction)
+                self._report(
+                    "GL302", node,
+                    "jax.jit(f) called immediately: the wrapper's trace "
+                    "cache dies with the expression — hoist the jitted "
+                    "callable to module scope or an lru_cache factory",
+                )
+            if isinstance(inner.func, ast.Call) and \
+                    _is_partial(inner.func.func) and inner.func.args and \
+                    _is_jit_expr(inner.func.args[0]):
+                self._report(
+                    "GL302", node,
+                    "functools.partial(jax.jit, ...)(f) called immediately: "
+                    "fresh wrapper per call — hoist it",
+                )
+        # GL303: unhashable literals in static positions
+        self._check_static_args(node)
+        self.generic_visit(node)
+
+    def _check_static_args(self, node: ast.Call) -> None:
+        """jit(..., static_argnums=...) called inline with literal
+        list/dict/set args in static positions."""
+        func = node.func
+        if not isinstance(func, ast.Call):
+            return
+        nums, names, is_jit = _jit_spec(func)
+        if not is_jit:
+            return
+        for i in nums:
+            if i < len(node.args) and isinstance(
+                    node.args[i], (ast.List, ast.Dict, ast.Set)):
+                self._report(
+                    "GL303", node.args[i],
+                    f"static arg {i} is an unhashable "
+                    f"{type(node.args[i]).__name__.lower()} literal — static "
+                    "args must be hashable (tuple / frozen dataclass)",
+                )
+        for kw in node.keywords:
+            if kw.arg in names and isinstance(
+                    kw.value, (ast.List, ast.Dict, ast.Set)):
+                self._report(
+                    "GL303", kw.value,
+                    f"static arg {kw.arg!r} is an unhashable literal — "
+                    "static args must be hashable",
+                )
+
+
+def check(module) -> list[Finding]:
+    v = _Visitor(module)
+    v.visit(module.tree)
+    return v.findings
+
+
+register_checker("GL3", check)

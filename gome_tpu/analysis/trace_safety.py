@@ -1,0 +1,639 @@
+"""GL1xx trace-safety: host-Python leaks inside jit/pallas-reachable code.
+
+A function is *traced* when jax transforms it: `@jax.jit` (directly or via
+`functools.partial(jax.jit, ...)`), a `jax.jit(f)` / `jax.vmap(f)` wrapper
+assignment, a kernel handed to `pl.pallas_call`, or any local function a
+traced function calls (including bodies handed to `lax.scan` / `lax.cond`
+/ `lax.while_loop` / `lax.fori_loop` / `jax.vmap` / `jax.tree.map` inside
+traced code). Inside traced functions, the non-static parameters are
+*tracers*, and host-Python operations on them either crash at trace time
+(`bool()`, `.item()`, `np.asarray`) or — worse — silently bake a single
+traced value into the compiled graph. The rules:
+
+  GL101  float()/int()/bool()/complex() on a tracer-derived value
+  GL102  .item()/.tolist() on a tracer-derived value
+  GL103  Python control flow (`if`/`while`/ternary/`assert`, or a `for`
+         directly over a tracer) on a tracer-derived value
+  GL104  numpy (`np.*`) call with a tracer-derived argument
+
+Taint model (documented limits — this is a linter, not an interpreter):
+
+  * non-static parameters of traced functions are TRACER; values derived
+    from them stay TRACER through arithmetic, jnp/lax calls, subscripts,
+    and attribute access;
+  * `.shape` / `.dtype` / `.ndim` / `.size` / `.itemsize` and `len(...)`
+    are static under tracing — accessing them DE-taints (this is exactly
+    why `while k < n` over a shape bound is fine in a jitted body);
+  * `list()/tuple()/zip()/enumerate()/reversed()/sorted()` over tracers
+    produce host CONTAINERS of tracers: iterating them is static
+    unrolling (the NamedTuple-of-rows idiom all over engine/step.py), so
+    only *direct* iteration of a TRACER value raises GL103;
+  * static args (`static_argnums`/`static_argnames`, values bound by a
+    `functools.partial` before `pallas_call`) are not tainted, and
+    closures over host values are never tainted;
+  * propagation is intra-module (entry points cover the public cross-
+    module surfaces in this codebase).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, register_checker, register_rules
+
+register_rules({
+    "GL101": "host cast (float/int/bool/complex) of a tracer inside traced code",
+    "GL102": ".item()/.tolist() on a tracer inside traced code",
+    "GL103": "Python control flow on a tracer-derived value inside traced code",
+    "GL104": "numpy call on a tracer-derived value inside traced code",
+})
+
+# taint lattice
+UNTAINTED, CONTAINER, TRACER = 0, 1, 2
+
+_STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "itemsize", "name", "_fields",
+    "weak_type", "sharding", "aval",
+}
+_DETAINT_CALLS = {"len", "isinstance", "type", "id", "repr", "str", "hash"}
+_CONTAINER_CALLS = {
+    "list", "tuple", "zip", "enumerate", "reversed", "sorted", "dict", "set",
+    "vars",
+}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_NUMPY_ROOTS = {"np", "numpy"}
+#: call-combinators whose function-valued arguments are traced with all
+#: params tainted when invoked from traced code: (root-path suffixes).
+_BODY_COMBINATORS = {
+    "scan", "cond", "while_loop", "fori_loop", "switch", "map",
+    "vmap", "pmap", "checkpoint", "remat", "custom_vjp", "associative_scan",
+}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """a.b.c -> 'a.b.c' (Names/Attributes only)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this expression denote jax.jit (possibly bare `jit`)?"""
+    d = _dotted(node)
+    return d is not None and (d == "jit" or d.endswith(".jit"))
+
+
+def _is_partial(node: ast.AST) -> bool:
+    d = _dotted(node) or ""
+    return d == "partial" or d.endswith(".partial")
+
+
+def _const_int_tuple(node: ast.AST | None) -> tuple[int, ...]:
+    if node is None:
+        return ()
+    try:
+        v = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return ()
+    if isinstance(v, int):
+        return (v,)
+    if isinstance(v, (tuple, list)):
+        return tuple(x for x in v if isinstance(x, int))
+    return ()
+
+
+def _const_str_tuple(node: ast.AST | None) -> tuple[str, ...]:
+    if node is None:
+        return ()
+    try:
+        v = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return ()
+    if isinstance(v, str):
+        return (v,)
+    if isinstance(v, (tuple, list)):
+        return tuple(x for x in v if isinstance(x, str))
+    return ()
+
+
+class _FuncInfo:
+    """One function/lambda/method in the module."""
+
+    def __init__(self, node, qualname: str, cls: str | None):
+        self.node = node
+        self.qualname = qualname
+        self.cls = cls  # enclosing class name for methods
+        args = node.args
+        self.params = [a.arg for a in args.posonlyargs + args.args]
+        self.kwonly = [a.arg for a in args.kwonlyargs]
+        # param name -> taint (joined over call sites / entry marking)
+        self.param_taint: dict[str, int] = {
+            p: UNTAINTED for p in self.params + self.kwonly
+        }
+        self.traced = False
+
+    def mark_entry(self, static_nums: tuple[int, ...],
+                   static_names: tuple[str, ...],
+                   bound: int = 0) -> bool:
+        """Mark as a traced entry; params except static/bound become
+        TRACER. Returns True if anything changed."""
+        changed = not self.traced
+        self.traced = True
+        for i, p in enumerate(self.params):
+            if i < bound or i in static_nums or p in static_names:
+                continue
+            if self.param_taint.get(p, UNTAINTED) < TRACER:
+                self.param_taint[p] = TRACER
+                changed = True
+        for p in self.kwonly:
+            if p in static_names:
+                continue
+            if self.param_taint.get(p, UNTAINTED) < TRACER:
+                self.param_taint[p] = TRACER
+                changed = True
+        return changed
+
+    def join_call(self, arg_taints: dict[str, int]) -> bool:
+        changed = not self.traced
+        self.traced = True
+        for p, t in arg_taints.items():
+            if t > self.param_taint.get(p, UNTAINTED):
+                self.param_taint[p] = t
+                changed = True
+        return changed
+
+
+class _Collector(ast.NodeVisitor):
+    """Collect every function/lambda with qualnames + class context, the
+    jit/pallas entry points, and wrapper assignments."""
+
+    def __init__(self):
+        self.funcs: dict[str, _FuncInfo] = {}  # qualname -> info
+        self.by_name: dict[str, list[_FuncInfo]] = {}  # bare name -> infos
+        self.by_node: dict[ast.AST, _FuncInfo] = {}
+        self.entries: list[tuple[_FuncInfo, tuple, tuple, int]] = []
+        self._scope: list[str] = []
+        self._cls: list[str] = []
+
+    # -- helpers -----------------------------------------------------------
+    def _add(self, node, name: str) -> _FuncInfo:
+        qual = ".".join(self._scope + [name])
+        info = _FuncInfo(node, qual, self._cls[-1] if self._cls else None)
+        self.funcs[qual] = info
+        self.by_name.setdefault(name, []).append(info)
+        self.by_node[node] = info
+        return info
+
+    def _mark_from_decorators(self, node, info: _FuncInfo) -> None:
+        for dec in node.decorator_list:
+            nums, names, is_jit = _jit_spec(dec)
+            if is_jit:
+                self.entries.append((info, nums, names, 0))
+            elif _is_trace_transform(dec):
+                self.entries.append((info, (), (), 0))
+
+    # -- visitors ----------------------------------------------------------
+    def visit_ClassDef(self, node):
+        self._scope.append(node.name)
+        self._cls.append(node.name)
+        self.generic_visit(node)
+        self._cls.pop()
+        self._scope.pop()
+
+    def _visit_func(self, node):
+        info = self._add(node, node.name)
+        self._mark_from_decorators(node, info)
+        self._scope.append(node.name)
+        cls = self._cls
+        self._cls = []  # nested defs inside a method are plain functions
+        self.generic_visit(node)
+        self._cls = cls
+        self._scope.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_func(node)
+
+    def visit_Lambda(self, node):
+        self._add(node, f"<lambda:{node.lineno}>")
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        # x = jax.jit(f) / partial(jax.jit, ...)(f) / jax.vmap(f)
+        self._check_wrapper(node.value)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        self._check_wrapper(node)
+        self.generic_visit(node)
+
+    def _check_wrapper(self, call) -> None:
+        if not isinstance(call, ast.Call):
+            return
+        func = call.func
+        nums: tuple = ()
+        names: tuple = ()
+        is_jit = False
+        if _is_jit_expr(func):
+            is_jit = True
+            nums, names = _jit_kwargs(call)
+        elif isinstance(func, ast.Call):
+            n2, s2, j2 = _jit_spec(func)
+            if j2:
+                is_jit, nums, names = True, n2, s2
+        elif _is_trace_transform(func) or (
+            isinstance(func, ast.Attribute) and _dotted(func) and
+            (_dotted(func).endswith(".pallas_call") or
+             _dotted(func) == "pallas_call")
+        ):
+            is_jit = True
+        if not is_jit:
+            return
+        for arg in call.args[:1]:
+            self._mark_callable_arg(arg, nums, names)
+
+    def _mark_callable_arg(self, arg, nums, names) -> None:
+        bound = 0
+        target = arg
+        if isinstance(arg, ast.Call) and _is_partial(arg.func) and arg.args:
+            target = arg.args[0]
+            bound = len(arg.args) - 1
+        if isinstance(target, ast.Name):
+            for info in self.by_name.get(target.id, ()):
+                self.entries.append((info, nums, names, bound))
+        elif isinstance(target, ast.Lambda):
+            info = self.by_node.get(target)
+            if info is not None:
+                self.entries.append((info, nums, names, bound))
+
+
+def _jit_kwargs(call: ast.Call) -> tuple[tuple, tuple]:
+    nums: tuple = ()
+    names: tuple = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums = _const_int_tuple(kw.value)
+        elif kw.arg == "static_argnames":
+            names = _const_str_tuple(kw.value)
+    return nums, names
+
+
+def _jit_spec(dec: ast.AST) -> tuple[tuple, tuple, bool]:
+    """Decode a decorator/wrapper expression into (static_argnums,
+    static_argnames, is_jit)."""
+    if _is_jit_expr(dec):
+        return (), (), True
+    if isinstance(dec, ast.Call):
+        if _is_jit_expr(dec.func):
+            nums, names = _jit_kwargs(dec)
+            return nums, names, True
+        if _is_partial(dec.func) and dec.args and _is_jit_expr(dec.args[0]):
+            nums, names = _jit_kwargs(dec)
+            return nums, names, True
+    return (), (), False
+
+
+def _is_trace_transform(node: ast.AST) -> bool:
+    """jax.vmap / jax.pmap / shard_map-style transform references."""
+    d = _dotted(node)
+    if d is None:
+        return False
+    leaf = d.rsplit(".", 1)[-1]
+    return leaf in ("vmap", "pmap", "shard_map", "grad", "value_and_grad")
+
+
+class _BodyScan(ast.NodeVisitor):
+    """Taint scan of ONE function body. Nested defs/lambdas are separate
+    scopes (visited by the driver, not here) — we only record the calls
+    that pass them around."""
+
+    def __init__(self, checker: "_Checker", info: _FuncInfo, emit: bool):
+        self.c = checker
+        self.info = info
+        self.emit = emit
+        self.taint: dict[str, int] = dict(info.param_taint)
+        self.findings: list[Finding] = []
+
+    # -- expression taint --------------------------------------------------
+    def t(self, node: ast.AST | None) -> int:
+        if node is None:
+            return UNTAINTED
+        method = getattr(self, f"_t_{type(node).__name__}", None)
+        if method is not None:
+            return method(node)
+        # default: join over child expressions
+        out = UNTAINTED
+        for child in ast.iter_child_nodes(node):
+            out = max(out, self.t(child))
+        return min(out, TRACER)
+
+    def _t_Name(self, node):
+        return self.taint.get(node.id, UNTAINTED)
+
+    def _t_Constant(self, node):
+        return UNTAINTED
+
+    def _t_Lambda(self, node):
+        return UNTAINTED  # a function object, not a tracer
+
+    def _t_Attribute(self, node):
+        if node.attr in _STATIC_ATTRS:
+            self.t(node.value)  # still scan for leaks inside
+            return UNTAINTED
+        return self.t(node.value)
+
+    def _t_Subscript(self, node):
+        return max(self.t(node.value), self.t(node.slice))
+
+    def _t_IfExp(self, node):
+        if self.t(node.test) >= TRACER:
+            self._report("GL103", node,
+                         "ternary condition on a tracer-derived value")
+        return max(self.t(node.body), self.t(node.orelse))
+
+    def _t_Compare(self, node):
+        out = self.t(node.left)
+        for cmp_ in node.comparators:
+            out = max(out, self.t(cmp_))
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            # identity tests (`x is None`) are host-static: they never
+            # concretize a tracer, so branching on them is fine.
+            return UNTAINTED
+        return out
+
+    def _t_Call(self, node):
+        fname = _dotted(node.func)
+        leaf = (fname or "").rsplit(".", 1)[-1]
+        arg_ts = [self.t(a) for a in node.args]
+        kw_ts = [self.t(k.value) for k in node.keywords]
+        worst = max(arg_ts + kw_ts, default=UNTAINTED)
+
+        # .item()/.tolist() on a tracer
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("item", "tolist"):
+            recv = self.t(node.func.value)
+            if recv >= TRACER:
+                self._report(
+                    "GL102", node,
+                    f".{node.func.attr}() forces a device sync / concretizes "
+                    "a tracer inside traced code",
+                )
+            return UNTAINTED
+        if fname in _DETAINT_CALLS:
+            return UNTAINTED
+        if fname in _HOST_CASTS:
+            if worst >= TRACER:
+                self._report(
+                    "GL101", node,
+                    f"{fname}() concretizes a tracer inside traced code "
+                    "(TracerBoolConversionError at trace time, or a baked-in "
+                    "constant)",
+                )
+            return UNTAINTED
+        if fname in _CONTAINER_CALLS:
+            return CONTAINER if worst else UNTAINTED
+        root = (fname or "").split(".", 1)[0]
+        if root in _NUMPY_ROOTS:
+            if worst >= TRACER:
+                self._report(
+                    "GL104", node,
+                    f"numpy call {fname}() on a tracer-derived value "
+                    "(host materialization inside traced code)",
+                )
+            return UNTAINTED
+        # combinators that trace a function argument
+        if leaf in _BODY_COMBINATORS and self.info.traced:
+            self.c.note_combinator(node, self)
+        # calls into local functions propagate taint to params
+        self.c.note_call(node, self, arg_ts)
+        # method call on a tainted receiver keeps taint (e.g. _replace)
+        if isinstance(node.func, ast.Attribute):
+            worst = max(worst, self.t(node.func.value))
+        return min(worst, TRACER)
+
+    # -- statements --------------------------------------------------------
+    def _assign(self, target, taint: int) -> None:
+        if isinstance(target, ast.Name):
+            self.taint[target.id] = taint
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                # unpacking a CONTAINER yields tracers
+                self._assign(el, TRACER if taint else UNTAINTED)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, taint)
+        # attribute/subscript stores don't track
+
+    def visit_Assign(self, node):
+        t = self.t(node.value)
+        for target in node.targets:
+            self._assign(target, t)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._assign(node.target, self.t(node.value))
+
+    def visit_AugAssign(self, node):
+        t = self.t(node.value)
+        if isinstance(node.target, ast.Name):
+            prev = self.taint.get(node.target.id, UNTAINTED)
+            self.taint[node.target.id] = max(prev, t)
+
+    def visit_If(self, node):
+        if self.t(node.test) >= TRACER:
+            self._report("GL103", node.test,
+                         "`if` on a tracer-derived value (host branch on a "
+                         "traced value; use jnp.where/lax.cond)")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self.t(node.test) >= TRACER:
+            self._report("GL103", node.test,
+                         "`while` on a tracer-derived value (use "
+                         "lax.while_loop)")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        if self.t(node.test) >= TRACER:
+            self._report("GL103", node.test,
+                         "`assert` on a tracer-derived value (use "
+                         "checkify or a masked guard)")
+        self.generic_visit(node)
+
+    def _iter_taint(self, node):
+        it = self.t(node.iter)
+        if it >= TRACER and isinstance(node.iter, ast.Name):
+            self._report(
+                "GL103", node.iter,
+                "`for` directly over a tracer (unrolls per-element; use "
+                "lax.scan/fori_loop)",
+            )
+        self._assign(node.target, TRACER if it else UNTAINTED)
+
+    def visit_For(self, node):
+        self._iter_taint(node)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def _comp(self, node):
+        for gen in node.generators:
+            it = self.t(gen.iter)
+            self._assign(gen.target, TRACER if it else UNTAINTED)
+            for cond in gen.ifs:
+                self.t(cond)
+        return self.t(getattr(node, "elt", None) or node.key), node
+
+    def _t_ListComp(self, node):
+        return self._comp(node)[0]
+
+    def _t_SetComp(self, node):
+        return self._comp(node)[0]
+
+    def _t_GeneratorExp(self, node):
+        return self._comp(node)[0]
+
+    def _t_DictComp(self, node):
+        for gen in node.generators:
+            it = self.t(gen.iter)
+            self._assign(gen.target, TRACER if it else UNTAINTED)
+        return max(self.t(node.key), self.t(node.value))
+
+    def visit_Expr(self, node):
+        self.t(node.value)
+
+    def visit_Return(self, node):
+        if node.value is not None:
+            self.t(node.value)
+
+    def visit_FunctionDef(self, node):
+        pass  # nested scopes visited by the driver
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_With(self, node):
+        for item in node.items:
+            self.t(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+
+    def generic_visit(self, node):
+        # statements we don't special-case: evaluate expressions for leaks
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.t(child)
+            else:
+                self.visit(child)
+
+    def run(self):
+        for stmt in self.info.node.body if not isinstance(
+                self.info.node, ast.Lambda) else []:
+            self.visit(stmt)
+        if isinstance(self.info.node, ast.Lambda):
+            self.t(self.info.node.body)
+        return self
+
+    def _report(self, rule: str, node: ast.AST, msg: str) -> None:
+        if self.emit:
+            self.findings.append(Finding(
+                rule, self.c.module.path, getattr(node, "lineno", 1),
+                getattr(node, "col_offset", 0),
+                f"{msg} [in {self.info.qualname}]",
+            ))
+
+
+class _Checker:
+    def __init__(self, module):
+        self.module = module
+        self.collector = _Collector()
+        self.collector.visit(module.tree)
+        self._changed = False
+
+    # -- call-graph notes (invoked during body scans) ----------------------
+    def note_call(self, node: ast.Call, scan: _BodyScan,
+                  arg_ts: list[int]) -> None:
+        if not scan.info.traced:
+            return
+        target = self._resolve(node.func, scan)
+        if target is None:
+            return
+        taints: dict[str, int] = {}
+        params = target.params
+        offset = 0
+        if isinstance(node.func, ast.Attribute) and params[:1] == ["self"]:
+            taints["self"] = min(scan.t(node.func.value), TRACER)
+            offset = 1
+        for i, t in enumerate(arg_ts):
+            if offset + i < len(params):
+                taints[params[offset + i]] = t
+        for kw, t in zip(node.keywords,
+                         [scan.t(k.value) for k in node.keywords]):
+            if kw.arg:
+                taints[kw.arg] = t
+        if target.join_call(taints):
+            self._changed = True
+
+    def note_combinator(self, node: ast.Call, scan: _BodyScan) -> None:
+        """lax.scan(body, ...) etc. inside traced code: the function-valued
+        args become traced with all params TRACER."""
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            target = None
+            if isinstance(arg, ast.Name):
+                cands = self.collector.by_name.get(arg.id, ())
+                target = cands[0] if len(cands) == 1 else None
+            elif isinstance(arg, ast.Lambda):
+                target = self.collector.by_node.get(arg)
+            if target is not None and target.mark_entry((), ()):
+                self._changed = True
+
+    def _resolve(self, func: ast.AST, scan: _BodyScan):
+        if isinstance(func, ast.Name):
+            cands = self.collector.by_name.get(func.id, ())
+            if len(cands) == 1:
+                return cands[0]
+            # prefer a sibling nested function in the same enclosing scope
+            for c in cands:
+                if c.qualname.rsplit(".", 1)[0] == \
+                        scan.info.qualname.rsplit(".", 1)[0]:
+                    return c
+            return cands[0] if cands else None
+        if isinstance(func, ast.Attribute):
+            # self.method()/obj.method(): resolve by unique method name
+            cands = [c for c in self.collector.by_name.get(func.attr, ())
+                     if c.cls is not None]
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def run(self) -> list[Finding]:
+        # seed entries
+        for info, nums, names, bound in self.collector.entries:
+            info.mark_entry(nums, names, bound)
+        # fixpoint: propagate taint along the intra-module call graph
+        for _ in range(12):
+            self._changed = False
+            for info in self.collector.funcs.values():
+                if info.traced:
+                    _BodyScan(self, info, emit=False).run()
+            if not self._changed:
+                break
+        findings: list[Finding] = []
+        for info in self.collector.funcs.values():
+            if info.traced:
+                findings.extend(_BodyScan(self, info, emit=True).run().findings)
+        return findings
+
+
+def check(module) -> list[Finding]:
+    return _Checker(module).run()
+
+
+register_checker("GL1", check)

@@ -220,21 +220,21 @@ class AmqpQueue(Queue, _Waitable):
         self._rpc_event = threading.Event()
         self._rpc_reply: tuple | None = None  # (token, (cls, mth, payload))
         self._rpc_expect: tuple | None = None  # ((cls, mth), token)
-        self._rpc_seq = 0  # correlation token source (see _rpc)
-        self._buffer: list[bytes] = []  # arrival order
-        self._tags: list[int] = []  # delivery tag per arrival
-        self._redelivered: list[bool] = []  # Basic.Deliver redelivered bit
-        self._hdrs: list[dict | None] = []  # basic-properties headers
-        self._committed = 0
-        self._acked_through = 0  # arrivals acked on the broker
-        self._published = 0  # our own publishes (loopback sync)
+        self._rpc_seq = 0  # guarded by self._rpc_lock (token source, _rpc)
+        self._buffer: list[bytes] = []  # guarded by self._lock (arrivals)
+        self._tags: list[int] = []  # guarded by self._lock (tag/arrival)
+        self._redelivered: list[bool] = []  # guarded by self._lock
+        self._hdrs: list[dict | None] = []  # guarded by self._lock
+        self._committed = 0  # guarded by self._lock
+        self._acked_through = 0  # guarded by self._lock (broker-acked)
+        self._published = 0  # guarded by self._lock (loopback sync)
         self._consuming = False
         self._closed = False
         self._frame_max = 131072
         self._pending_deliver: tuple | None = None
         self._confirm = False  # set after Confirm.Select below
-        self._pub_seq = 0  # confirm-mode publish sequence (1-based tags)
-        self._confirmed = 0  # highest broker-acked publish tag
+        self._pub_seq = 0  # guarded by self._lock (1-based confirm tags)
+        self._confirmed = 0  # guarded by self._ack_cond (ack frontier)
         self._ack_cond = threading.Condition()
 
         self._heartbeat = 0
@@ -614,8 +614,10 @@ class AmqpQueue(Queue, _Waitable):
         WE published has arrived back via consume."""
         self._ensure_consuming()
         deadline = time.monotonic() + self.SYNC_WAIT_S
-        while len(self._buffer) < self._published:
-            if self._closed or time.monotonic() >= deadline:
+        while True:
+            with self._lock:
+                caught_up = len(self._buffer) >= self._published
+            if caught_up or self._closed or time.monotonic() >= deadline:
                 break
             self._wait_for_publish(0.002)
 
@@ -685,7 +687,8 @@ class AmqpQueue(Queue, _Waitable):
             return max(len(self._buffer), self._published)
 
     def committed(self) -> int:
-        return self._committed
+        with self._lock:
+            return self._committed
 
     def commit(self, offset: int) -> None:
         self._ensure_consuming()
@@ -811,20 +814,20 @@ class SupervisedAmqpQueue(Queue):
         self.name = name
         self._state = threading.Lock()  # log/cursor fields below
         self._io = threading.RLock()  # serializes compound queue ops
-        self._log: list[bytes] = []  # wrapper-lifetime arrival log
-        self._log_hdrs: list[dict | None] = []  # headers per arrival
-        self._committed = 0
-        self._published = 0  # wrapper-lifetime publish count
-        self._consuming = False
+        self._log: list[bytes] = []  # guarded by self._state
+        self._log_hdrs: list[dict | None] = []  # guarded by self._state
+        self._committed = 0  # guarded by self._state
+        self._published = 0  # guarded by self._state
+        self._consuming = False  # guarded by self._state
         # Per-inner-connection cursors (reset by _on_reconnect): _n0 is
         # the log length when the connection opened, _r counts arrivals
         # skipped as redelivered, _inner_seen counts inner arrivals the
         # wrapper has consumed. Inner arrival j corresponds to log
         # position (_n0 - _r) + j — the formula the deferred broker acks
         # use to translate the committed cursor into a delivery tag.
-        self._n0 = 0
-        self._r = 0
-        self._inner_seen = 0
+        self._n0 = 0  # guarded by self._state
+        self._r = 0  # guarded by self._state
+        self._inner_seen = 0  # guarded by self._state
 
         def factory():
             # confirm=True: publish() returning means ENQUEUED — the

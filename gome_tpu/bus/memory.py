@@ -13,9 +13,9 @@ class MemoryQueue(_Waitable, Queue):
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
-        self._items: list[bytes] = []
-        self._headers: list[dict | None] = []
-        self._committed = 0
+        self._items: list[bytes] = []  # guarded by self._lock
+        self._headers: list[dict | None] = []  # guarded by self._lock
+        self._committed = 0  # guarded by self._lock
         self._init_wait()
 
     def publish(self, body: bytes, headers: dict | None = None) -> int:

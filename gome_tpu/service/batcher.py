@@ -88,26 +88,31 @@ class FrameBatcher:
         self.max_wait_s = max_wait_s
         self.spill_max_frames = spill_max_frames
         self.retry_interval_s = retry_interval_s
-        self._buf: list[Order] = []
-        self._spill: deque[bytes] = deque()  # encoded frames, FIFO
-        self._degraded_since: float | None = None
-        self.degraded_seconds_total = 0.0
+        self._buf: list[Order] = []  # guarded by self._lock
+        self._spill: deque[bytes] = deque()  # guarded by self._lock
+        self._degraded_since: float | None = None  # guarded by self._lock
+        self.degraded_seconds_total = 0.0  # guarded by self._lock
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop_event = threading.Event()
         self._stop = False
-        self._oldest: float | None = None  # monotonic time of buffer head
+        self._oldest: float | None = None  # guarded by self._lock
+        # Scrape-time callbacks run on the ops HTTP thread WITHOUT the
+        # lock on purpose: _flush_locked holds it across a bus publish,
+        # and a scrape must never stall behind (or deadlock against) a
+        # slow broker. len() and a float read are single bytecode ops
+        # under the GIL — a torn gauge is impossible, merely stale.
         REGISTRY.callback_gauge(
             "gome_gateway_spill_depth",
             "degraded-mode spill depth (ORDER frames awaiting the bus)",
-            lambda: len(self._spill),
+            lambda: len(self._spill),  # gomelint: disable=GL402 — see above
         )
         REGISTRY.callback_gauge(
             "gome_gateway_degraded_seconds",
             "seconds the gateway has been in degraded mode (0 healthy)",
             lambda: (
-                time.monotonic() - self._degraded_since
-                if self._degraded_since is not None
+                time.monotonic() - self._degraded_since  # gomelint: disable=GL402
+                if self._degraded_since is not None  # gomelint: disable=GL402
                 else 0.0
             ),
         )
@@ -119,7 +124,8 @@ class FrameBatcher:
     # -- degraded-mode state (callers: gateway handlers, health) -----------
     @property
     def degraded(self) -> bool:
-        return self._degraded_since is not None
+        with self._lock:
+            return self._degraded_since is not None
 
     def stats(self) -> dict:
         with self._lock:

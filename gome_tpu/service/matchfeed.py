@@ -44,7 +44,7 @@ class MatchFeed:
     def __init__(self, bus: QueueBus, log_events: bool = True):
         self.bus = bus
         self.log_events = log_events
-        self._subs: list[queue.Queue] = []
+        self._subs: list[queue.Queue] = []  # guarded by self._lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None

@@ -35,14 +35,16 @@ def _label_str(labels: dict | None, extra: dict | None = None) -> str:
 class Registry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._metrics: dict[str, object] = {}
+        self._metrics: dict[str, object] = {}  # guarded by self._lock
 
     def counter(
         self, name: str, help: str = "", labels: dict | None = None
     ) -> "Counter":
         if labels is None:
             return self._get(name, lambda: Counter(name, help))
-        fam = self._family(name, help, "counter", lambda lb: Counter(name, help, labels=lb))
+        fam = self._family(
+            name, help, "counter", lambda lb: Counter(name, help, labels=lb)
+        )
         return fam.child(labels)
 
     def gauge(
@@ -119,7 +121,7 @@ class Family:
         self.help = help
         self.typ = typ
         self._factory = child_factory
-        self._children: dict[tuple, object] = {}
+        self._children: dict[tuple, object] = {}  # guarded by self._lock
         self._lock = threading.Lock()
 
     def child(self, labels: dict):
@@ -154,7 +156,7 @@ class Counter:
         self.name = name
         self.help = help
         self.labels = labels
-        self._v = 0
+        self._v = 0  # guarded by self._lock
         self._lock = threading.Lock()
 
     def inc(self, by: int = 1) -> None:
@@ -180,7 +182,7 @@ class Gauge:
         self.name = name
         self.help = help
         self.labels = labels
-        self._v = 0.0
+        self._v = 0.0  # guarded by self._lock
         self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
@@ -249,9 +251,9 @@ class Histogram:
         self.help = help
         self.labels = labels
         self.buckets = tuple(buckets or _DEFAULT_BUCKETS)
-        self._counts = [0] * (len(self.buckets) + 1)
-        self._sum = 0.0
-        self._n = 0
+        self._counts = [0] * (len(self.buckets) + 1)  # guarded by self._lock
+        self._sum = 0.0  # guarded by self._lock
+        self._n = 0  # guarded by self._lock
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:

@@ -125,8 +125,8 @@ class RetryBudget:
         self.rate = rate
         self.burst = burst
         self._clock = clock
-        self._tokens = burst
-        self._last = clock()
+        self._tokens = burst  # guarded by self._lock
+        self._last = clock()  # guarded by self._lock
         self._lock = threading.Lock()
 
     def try_spend(self, n: float = 1.0) -> bool:
@@ -182,12 +182,12 @@ class CircuitBreaker:
         self._clock = clock
         self._on_transition = on_transition  # callable(old, new) | None
         self._lock = threading.Lock()
-        self._state = CLOSED
-        self._failures = 0  # consecutive, while CLOSED
-        self._opened_at = 0.0
-        self._probes = 0  # in-flight probes while HALF_OPEN
-        self.transitions: list[tuple[str, str]] = []  # bounded history
-        self.opened_total = 0
+        self._state = CLOSED  # guarded by self._lock
+        self._failures = 0  # guarded by self._lock (consecutive, CLOSED)
+        self._opened_at = 0.0  # guarded by self._lock
+        self._probes = 0  # guarded by self._lock (in-flight HALF_OPEN probes)
+        self.transitions: list[tuple[str, str]] = []  # guarded by self._lock
+        self.opened_total = 0  # guarded by self._lock
 
     @property
     def state(self) -> str:
@@ -322,12 +322,12 @@ class Supervised:
         self._sleep = sleep
         self._rng = rng
         self._lock = threading.RLock()
-        self._conn = None
-        self.connects_total = 0  # successful (re)connects
-        self.retries_total = 0  # operation retries after a fault
-        self.faults_total = 0  # connection faults observed
-        self._degraded_since: float | None = None
-        self.degraded_seconds_total = 0.0
+        self._conn = None  # guarded by self._lock
+        self.connects_total = 0  # guarded by self._lock (successful dials)
+        self.retries_total = 0  # guarded by self._lock (op retries)
+        self.faults_total = 0  # guarded by self._lock (faults observed)
+        self._degraded_since: float | None = None  # guarded by self._lock
+        self.degraded_seconds_total = 0.0  # guarded by self._lock
         with _SUPERVISORS_LOCK:
             _SUPERVISORS[name] = self
         m = _metric_name(name)

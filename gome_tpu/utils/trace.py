@@ -105,10 +105,10 @@ class FlightRecorder:
         self.slow_threshold_s = slow_threshold_s
         self.max_open = max_open
         self._lock = threading.Lock()
-        self._open: OrderedDict[str, list] = OrderedDict()
-        self._done: deque = deque(maxlen=keep_n)
-        self._slow: deque = deque(maxlen=keep_n)
-        self.dropped_open = 0  # evicted-before-complete journeys
+        self._open: OrderedDict[str, list] = OrderedDict()  # guarded by self._lock
+        self._done: deque = deque(maxlen=keep_n)  # guarded by self._lock
+        self._slow: deque = deque(maxlen=keep_n)  # guarded by self._lock
+        self.dropped_open = 0  # guarded by self._lock (evictions)
 
     def record(
         self, trace_id: str, stage: str, t0: float, t1: float, meta=None

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""gomelint — run the domain-specific static analyzers over the tree.
+
+    python scripts/gomelint.py gome_tpu                 # AST rules
+    python scripts/gomelint.py gome_tpu --jaxpr         # + jaxpr envelope
+    python scripts/gomelint.py gome_tpu --select GL4    # one family
+    python scripts/gomelint.py --list-rules
+
+Exit status: 0 when clean, 1 when any finding survives suppressions,
+2 on usage errors. `--report FILE` writes the findings as JSON (the CI
+analysis job uploads it as an artifact). The AST rules are dependency-
+free; `--jaxpr` imports jax and traces the engine's device entry points
+(a few seconds on CPU), auditing every intermediate value's dtype against
+the declared book envelope — see gome_tpu/analysis/envelope.py and the
+"Static analysis" section of ARCHITECTURE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gome_tpu.analysis import rule_catalogue, run_paths  # noqa: E402
+from gome_tpu.analysis.core import _ensure_checkers_loaded  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="gomelint", description=__doc__)
+    ap.add_argument("paths", nargs="*", help="files or directories")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule ids/prefixes (GL1,GL402,...)")
+    ap.add_argument("--jaxpr", action="store_true",
+                    help="also run the jaxpr int32-envelope audit (GL2xx)")
+    ap.add_argument("--dtype", default="int32", choices=("int32", "int64"),
+                    help="declared book dtype for the envelope audit")
+    ap.add_argument("--format", default="text", choices=("text", "json"))
+    ap.add_argument("--report", default="",
+                    help="write findings as JSON to this path")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="include findings silenced by gomelint directives")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        _ensure_checkers_loaded()
+        from gome_tpu.analysis import envelope  # noqa: F401 - registers GL2xx
+        for rule, desc in rule_catalogue().items():
+            print(f"{rule}  {desc}")
+        return 0
+    if not args.paths:
+        ap.error("no paths given (or use --list-rules)")
+
+    select = {s.strip().upper() for s in args.select.split(",") if s.strip()}
+    findings = run_paths(args.paths, select or None,
+                         keep_suppressed=args.show_suppressed)
+    if args.jaxpr and (not select or any(s.startswith("GL2") for s in select)):
+        from gome_tpu.analysis.envelope import check_engine_envelope
+        findings.extend(check_engine_envelope(args.dtype))
+
+    payload = [f.__dict__ for f in findings]
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            json.dump({"findings": payload, "count": len(findings)}, fh,
+                      indent=2)
+    if args.format == "json":
+        print(json.dumps(payload, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        print(f"gomelint: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

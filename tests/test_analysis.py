@@ -1,0 +1,452 @@
+"""gomelint golden fixtures: every rule family fires on seeded-bad input,
+stays silent on the idiomatic good twin, honors suppressions — and the
+whole tree comes back clean (the same gate CI's analysis job enforces)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gome_tpu.analysis import run_source
+from gome_tpu.analysis.core import rule_catalogue, run_paths
+from gome_tpu.analysis.envelope import check_engine_envelope, check_jaxpr
+from gome_tpu.analysis.runtime import (
+    LockDisciplineError,
+    OwnedLock,
+    instrument,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --- GL1xx trace-safety ---------------------------------------------------
+
+
+BAD_TRACE = '''
+import jax
+import numpy as np
+
+@jax.jit
+def f(x, y):
+    if x > 0:
+        y = y + 1
+    v = float(x)
+    w = x.item()
+    z = np.asarray(y)
+    for row in x:
+        z = z + 1
+    return v + w
+'''
+
+
+def test_trace_safety_flags_bad_fixture():
+    findings = run_source(BAD_TRACE)
+    assert rules_of(findings) == ["GL101", "GL102", "GL103", "GL104"]
+    # the `if` and the `for` are two distinct GL103 sites
+    assert sum(f.rule == "GL103" for f in findings) == 2
+
+
+def test_trace_safety_propagates_through_call_graph():
+    src = '''
+import jax
+
+def helper(a):
+    return int(a)
+
+@jax.jit
+def g(x):
+    return helper(x)
+'''
+    findings = run_source(src)
+    assert rules_of(findings) == ["GL101"]
+    assert "helper" in findings[0].message
+
+
+def test_trace_safety_scan_body_is_traced():
+    src = '''
+import jax
+
+@jax.jit
+def g(xs):
+    def body(carry, x):
+        return carry, float(x)
+    return jax.lax.scan(body, 0, xs)
+'''
+    assert rules_of(run_source(src)) == ["GL101"]
+
+
+GOOD_TRACE = '''
+import functools
+import jax
+import jax.numpy as jnp
+
+@functools.partial(jax.jit, static_argnums=0)
+def f(config, x):
+    n = x.shape[-1]
+    if config.cap > 4:          # static arg: host branching is fine
+        x = x + 1
+    k = 1
+    while k < n:                # shape-derived bound: static under trace
+        x = x + jnp.pad(x[..., :-k], [(0, 0)] * (x.ndim - 1) + [(k, 0)])
+        k *= 2
+    if jnp.dtype(x.dtype).itemsize <= 4:
+        x = jnp.minimum(x, 7)
+    return x
+
+def host_only(a):
+    return float(a.sum())       # not reachable from any jit entry
+'''
+
+
+def test_trace_safety_good_twin_is_clean():
+    assert run_source(GOOD_TRACE) == []
+
+
+def test_trace_safety_identity_test_is_static():
+    # `x is None` never concretizes a tracer — branching on it is host-
+    # static (the bench's mixed full/dense round-chain relies on this)
+    src = '''
+import jax
+
+@jax.jit
+def f(x, ids):
+    if ids is None:
+        return x
+    return x + 1
+'''
+    assert run_source(src) == []
+
+
+def test_trace_safety_namedtuple_unroll_idiom_is_clean():
+    # the engine/step.py idiom: iterate a host container of tracers
+    src = '''
+import jax
+
+@jax.jit
+def f(own, entry):
+    out = list(own)
+    for a in out:
+        a = a + 1
+    pairs = [a + v for a, v in zip(own, entry)]
+    return pairs
+'''
+    assert run_source(src) == []
+
+
+def test_trace_safety_line_suppression():
+    src = '''
+import jax
+
+@jax.jit
+def f(x):
+    return float(x)  # gomelint: disable=GL101 — fixture-sanctioned
+'''
+    assert run_source(src) == []
+    assert rules_of(run_source(src, keep_suppressed=True)) == ["GL101"]
+
+
+def test_file_suppression():
+    src = '''
+# gomelint: disable-file=GL101
+import jax
+
+@jax.jit
+def f(x):
+    return float(x)
+'''
+    assert run_source(src) == []
+
+
+# --- GL2xx int32-envelope (jaxpr) ----------------------------------------
+
+
+def test_envelope_flags_float_and_width_creep():
+    x = jnp.zeros((4,), jnp.int32)
+    f32 = jax.make_jaxpr(lambda v: v.astype(jnp.float32) * 2.5)(x)
+    assert rules_of(check_jaxpr(f32, "int32", "fixture")) == ["GL202"]
+
+    with jax.experimental.enable_x64():
+        i64 = jax.make_jaxpr(
+            lambda v: v.astype(jnp.int64) + 1
+        )(jnp.zeros((4,), jnp.int32))
+        f64 = jax.make_jaxpr(lambda v: v * 2.5)(jnp.zeros((4,), jnp.float64))
+    assert rules_of(check_jaxpr(i64, "int32", "fixture")) == ["GL203"]
+    assert "GL201" in rules_of(check_jaxpr(f64, "int32", "fixture"))
+
+
+def test_envelope_recurses_into_nested_jaxprs():
+    # the creep hides inside a scan body — the walk must find it
+    def body(c, x):
+        return c, x.astype(jnp.float32) * 0.5
+
+    closed = jax.make_jaxpr(
+        lambda xs: jax.lax.scan(body, jnp.int32(0), xs)
+    )(jnp.zeros((4,), jnp.int32))
+    assert "GL202" in rules_of(check_jaxpr(closed, "int32", "nested"))
+
+
+def test_envelope_int64_engine_allows_int64():
+    with jax.experimental.enable_x64():
+        i64 = jax.make_jaxpr(
+            lambda v: v + 1
+        )(jnp.zeros((4,), jnp.int64))
+    assert check_jaxpr(i64, "int64", "fixture") == []
+
+
+@pytest.mark.parametrize("dtype", ["int32", "int64"])
+def test_engine_envelope_clean(dtype):
+    """The real engine graphs — step, batch, dense, compaction, scatter,
+    pallas-interpret — audited in the dtype's native x64 mode."""
+    assert check_engine_envelope(dtype) == []
+
+
+# --- GL3xx recompile-hazard ----------------------------------------------
+
+
+BAD_RECOMPILE = '''
+import functools
+import jax
+
+def make(n):
+    @jax.jit
+    def f(x):
+        return x * n
+    return f
+
+def run(x):
+    return jax.jit(lambda v: v + 1)(x)
+
+class Engine:
+    @jax.jit
+    def step(self, x):
+        return x
+
+y = jax.jit(lambda x: x, static_argnums=(0,))([1, 2])
+'''
+
+
+def test_recompile_flags_bad_fixture():
+    assert rules_of(run_source(BAD_RECOMPILE)) == [
+        "GL301", "GL302", "GL303", "GL304",
+    ]
+
+
+GOOD_RECOMPILE = '''
+import functools
+import jax
+
+@functools.lru_cache(maxsize=256)
+def make(n):                     # the engine/frames.py factory idiom
+    @jax.jit
+    def f(x):
+        return x * n
+    return f
+
+@jax.jit
+def top(x):
+    return x
+
+step = functools.partial(jax.jit, static_argnums=0)(top)
+'''
+
+
+def test_recompile_good_twin_is_clean():
+    assert run_source(GOOD_RECOMPILE) == []
+
+
+# --- GL4xx lock-discipline -----------------------------------------------
+
+
+BAD_LOCKS = '''
+import threading
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []          # guarded by self._lock
+        self.total = 0          # guarded by self._lock
+        self._ghost = 0         # guarded by self._missing
+
+    def submit(self, o):
+        with self._lock:
+            self._buf.append(o)
+        self.total += 1
+
+    def peek(self):
+        return len(self._buf)
+
+    def escape(self):
+        with self._lock:
+            return lambda: self._buf.pop()
+'''
+
+
+def test_locks_flags_bad_fixture():
+    findings = run_source(BAD_LOCKS)
+    assert rules_of(findings) == ["GL401", "GL402", "GL403"]
+    lines = {f.rule: f.line for f in findings}
+    assert lines["GL401"] == 14  # self.total += 1 off-lock
+    # the closure escaping the with-block is an off-lock read
+    assert any(f.rule == "GL402" and f.line == 21 for f in findings)
+
+
+GOOD_LOCKS = '''
+import threading
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = []          # guarded by self._lock
+        self.total = 0          # guarded by self._lock
+
+    def submit(self, o):
+        with self._lock:
+            self._buf.append(o)
+            self.total += 1
+
+    def _flush_locked(self):
+        batch, self._buf = self._buf, []
+        return batch
+
+    # holds: self._lock
+    def annotated(self):
+        return list(self._buf)
+
+    def flush(self):
+        with self._lock:
+            return self._flush_locked()
+'''
+
+
+def test_locks_good_twin_is_clean():
+    assert run_source(GOOD_LOCKS) == []
+
+
+def test_locks_condition_counts_as_lock():
+    src = '''
+import threading
+
+class Q:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._n = 0  # guarded by self._cond
+
+    def bump(self):
+        with self._cond:
+            self._n += 1
+            self._cond.notify_all()
+'''
+    assert run_source(src) == []
+
+
+# --- GL4xx runtime assertion mode ----------------------------------------
+
+
+class _Thing:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counter = 0
+
+    def bump(self):
+        with self._lock:
+            self.counter += 1
+
+    def racy_bump(self):
+        self.counter += 1
+
+
+def test_runtime_instrument_catches_off_lock_write():
+    t = _Thing()
+    lock = instrument(t, ("counter",))
+    t.bump()  # disciplined write: fine
+    assert t.counter == 1
+    with pytest.raises(LockDisciplineError):
+        t.racy_bump()
+    # the violating write did not land
+    assert t.counter == 1
+    assert isinstance(lock, OwnedLock)
+
+
+def test_runtime_owned_lock_tracks_owner():
+    lock = OwnedLock()
+    assert not lock.held_by_me()
+    with lock:
+        assert lock.held_by_me()
+        seen = []
+        th = threading.Thread(target=lambda: seen.append(lock.held_by_me()))
+        th.start()
+        th.join()
+        assert seen == [False]
+    assert not lock.held_by_me()
+
+
+def test_runtime_instrument_on_real_batcher():
+    """The production FrameBatcher under runtime assertions: a full
+    submit/flush cycle never writes its guarded state off-lock."""
+    from gome_tpu.bus.memory import MemoryQueue
+    from gome_tpu.service.batcher import FrameBatcher
+    from gome_tpu.types import Action, Order, OrderType, Side
+
+    b = FrameBatcher(MemoryQueue("doOrder"), max_n=2, max_wait_s=60)
+    try:
+        instrument(b, ("_buf", "_spill", "_oldest", "_degraded_since"))
+        for i in range(4):
+            b.submit(Order(
+                uuid="u", oid=f"o{i}", symbol="S", side=Side.BUY,
+                price=100, volume=1, action=Action.ADD,
+                order_type=OrderType.LIMIT,
+            ))
+        b.flush()
+    finally:
+        b.close()
+
+
+# --- whole-tree clean runs (the CI gate) ---------------------------------
+
+
+def test_whole_tree_is_clean():
+    findings = run_paths([os.path.join(ROOT, "gome_tpu")])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_cli_exits_zero_on_tree_and_lists_rules():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "gomelint.py"),
+         os.path.join(ROOT, "gome_tpu"), "--report",
+         os.path.join(ROOT, ".gomelint-test-report.json")],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 finding(s)" in out.stdout
+    import json
+    with open(os.path.join(ROOT, ".gomelint-test-report.json")) as fh:
+        report = json.load(fh)
+    assert report["count"] == 0
+    os.unlink(os.path.join(ROOT, ".gomelint-test-report.json"))
+
+    rules = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "gomelint.py"),
+         "--list-rules"],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+    )
+    assert rules.returncode == 0
+    for rule in ("GL101", "GL201", "GL301", "GL401"):
+        assert rule in rules.stdout
+
+
+def test_rule_catalogue_covers_all_families():
+    from gome_tpu.analysis import envelope  # noqa: F401 — registers GL2xx
+    cat = rule_catalogue()
+    for family in ("GL1", "GL2", "GL3", "GL4"):
+        assert any(r.startswith(family) for r in cat), family
