@@ -1042,12 +1042,16 @@ def grpc_main():
         for m in bus.match_queue.read_from(ev_skip, 1 << 30)
     )
     rate = N / elapsed
+    client_mode = (
+        "DoOrderBatch x" + str(CLIENT_BATCH) if MODE == "batch"
+        else "unary DoOrder"
+    )
     print(
         json.dumps(
             {
                 "metric": (
                     "gRPC-inclusive throughput: doorder client "
-                    f"({'DoOrderBatch x' + str(CLIENT_BATCH) if MODE == 'batch' else 'unary DoOrder'}, "
+                    f"({client_mode}, "
                     f"concurrency {CONC}, separate process) -> real "
                     f"OrderGateway -> FrameBatcher({BATCH}) -> frame "
                     f"consumer -> matchOrder; {S} symbols, single-core "
@@ -1523,7 +1527,8 @@ def service_sharded_main(n_shards: int):
         }
         print(json.dumps(result))
         per_shard = ", ".join(
-            f"s{i}: {r['orders']}@{r['orders'] / max(r['t_consumer'], 1e-9) / 1e3:.0f}K/s"
+            f"s{i}: {r['orders']}"
+            f"@{r['orders'] / max(r['t_consumer'], 1e-9) / 1e3:.0f}K/s"
             f" (cpu {r['orders'] / max(r.get('cpu', 0), 1e-9) / 1e3:.0f}K/s/core)"
             for i, r in enumerate(reports)
         )
@@ -1950,7 +1955,10 @@ def main():
     throughput = orders / elapsed
     cfg_tag = f", config {CFG}" if CFG else ""
     result = {
-        "metric": f"device matching throughput, {S} symbols x {T}-deep grids, cap={CAP}, {DTYPE} ticks, {KERNEL} kernel{cfg_tag}",
+        "metric": (
+            f"device matching throughput, {S} symbols x {T}-deep "
+            f"grids, cap={CAP}, {DTYPE} ticks, {KERNEL} kernel{cfg_tag}"
+        ),
         "value": round(throughput),
         "unit": "orders/sec",
         "vs_baseline": round(throughput / 1_000_000, 3),

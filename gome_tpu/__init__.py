@@ -9,13 +9,16 @@ chips with `jax.sharding`.
 
 Layout:
   gome_tpu.types    — domain types (Side, Action, Order, MatchResult)
-  gome_tpu.fixed    — fixed-point scaling (reference: gomengine/engine/ordernode.go:76-87)
+  gome_tpu.fixed    — fixed-point scaling (reference:
+                      gomengine/engine/ordernode.go:76-87)
   gome_tpu.oracle   — pure-Python executable model of the reference semantics
   gome_tpu.engine   — JAX book state + match/cancel step functions
   gome_tpu.ops      — Pallas TPU kernels for the hot path
   gome_tpu.parallel — device mesh, shardings, symbol routing
-  gome_tpu.bridge   — gRPC/socket front door + micro-batcher (reference: gomengine/main.go)
-  gome_tpu.persist  — snapshot/restore + replay recovery (reference: Redis-is-the-book, SURVEY §5.4)
+  gome_tpu.bridge   — gRPC/socket front door + micro-batcher
+                      (reference: gomengine/main.go)
+  gome_tpu.persist  — snapshot/restore + replay recovery (reference:
+                      Redis-is-the-book, SURVEY §5.4)
   gome_tpu.utils    — config, logging, metrics
 """
 

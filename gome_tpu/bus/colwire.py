@@ -35,6 +35,8 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ..utils.cache import IdentityCache
+
 ORDER_MAGIC = b"GCO2"
 ORDER_MAGIC_V1 = b"GCO1"  # decode-compat: pre-cache dict-column layout
 #: GCO2 + one trailing padded per-order trace-context column (utils.trace
@@ -71,8 +73,6 @@ _EVENT_NUM = (
     ("is_market", np.uint8),
 )
 
-
-from ..utils.cache import IdentityCache
 
 # Decoded dict-column uniques, content-addressed by their raw wire bytes.
 # Real order flow re-sends the same symbol/uuid dictionary frame after
@@ -213,7 +213,7 @@ def encode_order_frame(
     selects the GCO3 layout (a trailing padded column)."""
     magic = ORDER_MAGIC if traces is None else ORDER_MAGIC_TRACED
     parts = [magic, struct.pack("<I", n)]
-    for (name, dt), col in zip(
+    for (_name, dt), col in zip(
         _ORDER_NUM, (action, side, kind, price, volume)
     ):
         parts.append(np.ascontiguousarray(col, dt).tobytes())

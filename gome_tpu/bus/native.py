@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import sys
 import threading
 
 from .base import Message, Queue, _Waitable

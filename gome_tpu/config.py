@@ -20,7 +20,10 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any
+from typing import TYPE_CHECKING, Any, TypeVar
+
+if TYPE_CHECKING:
+    from .engine.book import BookConfig
 
 import yaml
 
@@ -77,7 +80,7 @@ class BusConfig:
 
     _BACKENDS = ("memory", "file", "cfile", "amqp")
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if self.backend not in self._BACKENDS:
             raise ValueError(
                 f"bus.backend must be one of {self._BACKENDS}, "
@@ -113,7 +116,7 @@ class EngineConfig:
     # mesh (single chip). n_slots must be a multiple of mesh_devices.
     mesh_devices: int = 0
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if not 0 <= self.accuracy <= 18:
             raise ValueError(f"accuracy must be in [0, 18], got {self.accuracy}")
         for name in ("cap", "max_fills", "n_slots", "max_t"):
@@ -133,9 +136,10 @@ class EngineConfig:
                 f"engine.kernel must be one of {KERNELS}, got {self.kernel}"
             )
 
-    def book_config(self):
-        from .engine.book import BookConfig
+    def book_config(self) -> "BookConfig":
         import jax.numpy as jnp
+
+        from .engine.book import BookConfig
 
         return BookConfig(
             cap=self.cap,
@@ -156,7 +160,7 @@ class PersistConfig:
     every_n_batches: int = 64
     keep: int = 4
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if self.every_n_batches <= 0 or self.keep <= 0:
             raise ValueError("persist cadence/keep must be positive")
 
@@ -180,7 +184,7 @@ class OpsConfig:
     trace_keep: int = 64  # flight-recorder ring size (journeys)
     slow_ms: float = 50.0  # slow-order threshold (pinned in the slow ring)
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if self.trace_keep <= 0:
             raise ValueError(
                 f"ops.trace_keep must be positive, got {self.trace_keep}"
@@ -201,8 +205,11 @@ class Config:
     ops: OpsConfig = OpsConfig()
 
 
-def _build(cls, raw: dict[str, Any], section: str):
-    fields = {f.name: f for f in dataclasses.fields(cls)}
+_C = TypeVar("_C")
+
+
+def _build(cls: type[_C], raw: dict[str, Any], section: str) -> _C:
+    fields = {f.name: f for f in dataclasses.fields(cls)}  # type: ignore[arg-type]
     kwargs = {}
     for key, value in raw.items():
         if key not in fields:

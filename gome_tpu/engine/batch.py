@@ -969,7 +969,11 @@ class BatchEngine:
         state (multi-grid batches commit device books per grid — without the
         rollback, replaying a batch that failed on grid 2 would double-apply
         grid 1's orders)."""
-        return [ev for _, evs in self.process_indexed(list(enumerate(orders))) for ev in evs]
+        return [
+            ev
+            for _, evs in self.process_indexed(list(enumerate(orders)))
+            for ev in evs
+        ]
 
     def process_indexed(
         self, indexed: list[tuple[int, Order]]
@@ -1586,7 +1590,9 @@ class BatchEngine:
             slot = np.arange(cap)
             active = slot[None, None, :] < counts[:, :, None]
             self._env_lo = np.where(
-                occupied, np.where(active, prices, np.iinfo(np.int64).max).min((1, 2)), 0
+                occupied,
+                np.where(active, prices, np.iinfo(np.int64).max).min((1, 2)),
+                0,
             )
             self._env_hi = np.where(
                 occupied, np.where(active, prices, 0).max((1, 2)), 0

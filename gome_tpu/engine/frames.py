@@ -44,18 +44,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..types import Action, OrderType
+from ..utils.trace import TRACER
+from .batch import BatchEngine, _next_pow2, _next_pow4, splice_outs
+from .book import GRID_I32_FIELDS, DeviceOp
+from .step import ACTION_ADD, LOT_MAX32
+
 #: Cumulative wall-clock seconds apply_frame_fast spent BLOCKED on the
 #: device->host fetch of compacted events. On a tunneled dev TPU this link
 #: runs at single-digit MB/s and dominates end-to-end service time; the
 #: service bench subtracts it to report the pipeline's capability on
 #: production (PCIe-attached) hardware alongside the measured number.
 FETCH_SECONDS = 0.0
-
-from ..types import Action, OrderType
-from ..utils.trace import TRACER
-from .batch import BatchEngine, _next_pow2, _next_pow4, splice_outs
-from .book import GRID_I32_FIELDS, DeviceOp
-from .step import ACTION_ADD, LOT_MAX32
 
 ACTION_DEL = int(Action.DEL)
 MARKET = int(OrderType.MARKET)
@@ -361,7 +361,7 @@ def _pack_class_train(eng: BatchEngine, a: dict, active_idx, t_sub,
             is_mkt = (a["kind"][sel] == MARKET) & (
                 a["action"][sel] == ACTION_ADD
             )
-            for i, (name, val) in enumerate(
+            for i, (_name, val) in enumerate(
                 (
                     ("action", a["action"][sel]),
                     ("side", a["side"][sel]),
@@ -504,7 +504,6 @@ def _decode_compact(eng, meta, shape, fetched) -> dict:
     src = fills["src"][:nf].astype(np.int64)
     rr = src // (t_len * k)
     tt = (src // k) % t_len
-    kk = src % k
     pos = op_index[rr, tt]  # every fill belongs to a packed ADD
     base = meta["price_base"][pos]
     fill_cols = {

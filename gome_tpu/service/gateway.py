@@ -28,7 +28,7 @@ from ..config import Config
 from ..fixed import scale
 from ..types import Action, Order, OrderType, Side
 from ..utils.logging import get_logger
-from ..utils.trace import TRACER, encode_context
+from ..utils.trace import TRACER
 
 log = get_logger("gateway")
 

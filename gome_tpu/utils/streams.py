@@ -10,7 +10,6 @@ and mixed streams with cancels (config 2) / market orders (config 5).
 from __future__ import annotations
 
 import random
-from collections.abc import Iterator
 
 from ..fixed import scale
 from ..types import Action, Order, OrderType, Side

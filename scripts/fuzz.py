@@ -63,7 +63,10 @@ def run_case(seed: int) -> str:
     mode = str(rng.choice(["object", "columnar", "frame"]))
     n_symbols = int(rng.choice([1, 3, 7]))
     base_price = int(
-        rng.choice([100, 10_000_000, 10_000_000_000_000 if dtype == jnp.int32 else 100_000])
+        rng.choice(
+            [100, 10_000_000,
+             10_000_000_000_000 if dtype == jnp.int32 else 100_000]
+        )
     )
     band = int(rng.choice([3, 50, 5_000]))
     n_orders = int(rng.choice([50, 200]))
@@ -177,7 +180,11 @@ def run_case(seed: int) -> str:
             f"{expected[first] if first < len(expected) else '<none>'}"
         )
     engine.verify_books()
-    return f"OK [{desc}] events={len(got)} esc={engine.stats.cap_escalations}/{engine.stats.fill_record_escalations}"
+    return (
+        f"OK [{desc}] events={len(got)} esc="
+        f"{engine.stats.cap_escalations}"
+        f"/{engine.stats.fill_record_escalations}"
+    )
 
 
 def main():

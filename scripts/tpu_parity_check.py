@@ -154,7 +154,6 @@ def run_edge_price_parity(S=128, T=8, CAP=32, K=8, log=print) -> int:
         return 0
     config = BookConfig(cap=CAP, max_fills=K, dtype=jnp.int32)
     bs = default_block_s(S, CAP)
-    r = np.random.default_rng(13)
     half = (1 << 30) - 1000
 
     def ops(seed, base):

@@ -11,7 +11,6 @@ never pay one hot lane's escalated depth. These tests pin:
 """
 
 import numpy as np
-import pytest
 
 from gome_tpu.engine import BatchEngine, BookConfig
 from gome_tpu.engine.batch import CAP_CLASS_MIN, _cap_ladder

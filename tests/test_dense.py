@@ -5,11 +5,10 @@ full-grid path."""
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from gome_tpu.engine import BatchEngine, BookConfig
 from gome_tpu.oracle import OracleEngine
-from gome_tpu.types import Action, Order, OrderType, Side
+from gome_tpu.types import Action, Order, Side
 from gome_tpu.utils.streams import multi_symbol_stream
 
 

@@ -186,7 +186,9 @@ def test_book_overflow_flagged_not_silent():
     op = encode_op(o(99, Side.SALE, 3.00, 1.0), h.oids, h.uids)
     h.book, out = h._step(h.book, op)
     assert int(out.book_overflow) == 1 and int(out.rested) == 0
-    assert h.depth(Side.SALE, 8) == [(scale(2.00 + i / 100), scale(1.0)) for i in range(4)]
+    assert h.depth(Side.SALE, 8) == [
+        (scale(2.00 + i / 100), scale(1.0)) for i in range(4)
+    ]
 
 
 def test_fill_overflow_reported():

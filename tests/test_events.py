@@ -1,7 +1,6 @@
 """Columnar decode path (engine/events.py): exact equivalence with the
 per-op object decoder and with the oracle, plus wire-format byte parity."""
 
-import numpy as np
 
 from gome_tpu.bus.codec import encode_match_result
 from gome_tpu.engine import BatchEngine, BookConfig

@@ -119,7 +119,6 @@ def test_frame_path_int32_rebasing_and_dropped_dels():
 def test_fast_path_falls_back_on_escalation():
     """apply_frame_fast must detect tripped budgets (book overflow, record
     truncation) via the compaction totals and re-run exactly."""
-    rng = np.random.default_rng(31)
     orders = [
         Order(uuid="u", oid=str(i), symbol="s", side=Side.SALE,
               price=100 + i, volume=1)
@@ -375,7 +374,9 @@ def test_geometry_manifest_precompile_round_trip(tmp_path):
             n_slots=64, max_t=8,
         )
 
-    orders = multi_symbol_stream(n=600, n_symbols=24, seed=5, zipf_a=1.2, cancel_prob=0.3)
+    orders = multi_symbol_stream(
+        n=600, n_symbols=24, seed=5, zipf_a=1.2, cancel_prob=0.3
+    )
 
     # Run 1: record the manifest.
     e1 = mk()

@@ -45,7 +45,6 @@ def test_volume_conservation_and_non_crossing(seed):
     admitted_volume = 0
     filled = 0
     cancelled = 0
-    market_dropped = 0
     for i in range(0, len(orders), 16):
         chunk = orders[i : i + 16]
         for o in chunk:
@@ -79,7 +78,6 @@ def test_volume_conservation_and_non_crossing(seed):
         # + resting + dropped-market-remainders (the residual)
         residual = admitted_volume - filled - cancelled - resting
         assert residual >= 0  # only market drops may remain unaccounted
-        market_dropped = residual
 
 
 def test_seq_monotonic_within_level():

@@ -6,7 +6,7 @@ and friends — citations inline) and serve as the spec for the JAX engine.
 
 from gome_tpu.fixed import scale
 from gome_tpu.oracle import OracleEngine
-from gome_tpu.types import Action, MatchResult, Order, OrderType, Side
+from gome_tpu.types import Action, Order, OrderType, Side
 from gome_tpu.utils.streams import doorder_stream, mixed_stream
 
 

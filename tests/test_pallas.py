@@ -8,7 +8,6 @@ import pytest
 from bench import build_grids
 from gome_tpu.engine import BatchEngine, BookConfig, batch_step, init_books
 from gome_tpu.engine.book import DeviceOp
-from gome_tpu.fixed import scale
 from gome_tpu.oracle import OracleEngine
 from gome_tpu.ops import pallas_batch_step
 from gome_tpu.utils.streams import mixed_stream

@@ -3,13 +3,12 @@ match stream), snapshot store atomicity/pruning, Redis-schema export."""
 
 import json
 import os
-import shutil
 
 import pytest
 
 from gome_tpu.bus import decode_match_result, encode_order, make_bus
 from gome_tpu.config import BusConfig, Config, EngineConfig, PersistConfig
-from gome_tpu.persist import Persister, SnapshotStore, book_redis_commands
+from gome_tpu.persist import Persister, SnapshotStore
 from gome_tpu.persist.redis_schema import export_to_redis
 from gome_tpu.service import EngineService
 from gome_tpu.utils.streams import mixed_stream

@@ -14,7 +14,7 @@ from gome_tpu.engine.orchestrator import MatchEngine
 from gome_tpu.engine.pipeline import FramePipeline
 from gome_tpu.oracle import OracleEngine
 from gome_tpu.service.consumer import OrderConsumer
-from gome_tpu.types import Action, Order, Side
+from gome_tpu.types import Order, Side
 from gome_tpu.utils.streams import multi_symbol_stream
 
 from test_frames import orders_to_frame

@@ -169,7 +169,7 @@ def _run_flow(engine, bus, orders, mid_kill=None):
         bus.order_queue.committed() < bus.order_queue.end_offset()
         and time.monotonic() < deadline
     ):
-        n = consumer.step_with_policy()
+        consumer.step_with_policy()
         if mid_kill is not None:
             mid_kill(bus.order_queue.committed())
     assert bus.order_queue.committed() == bus.order_queue.end_offset()

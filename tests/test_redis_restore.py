@@ -14,7 +14,6 @@ from gome_tpu.oracle import OracleEngine
 from gome_tpu.persist import DictRedis, restore_from_redis
 from gome_tpu.persist.redis_schema import export_to_redis
 from gome_tpu.types import Action, Order, Side
-from gome_tpu.utils.streams import multi_symbol_stream
 
 
 def _run_marked(engine, orders):
@@ -29,8 +28,14 @@ def _books_semantically_equal(a, b):
     """Compare lane_books through the interner tables (interner id
     assignment order differs between a fresh engine and a restored one)."""
     ba, bb = a.batch.lane_books(), b.batch.lane_books()
-    la = {a.batch.symbols.lookup(i + 1): i for i in range(len(a.batch.symbols.to_list()))}
-    lb = {b.batch.symbols.lookup(i + 1): i for i in range(len(b.batch.symbols.to_list()))}
+    la = {
+        a.batch.symbols.lookup(i + 1): i
+        for i in range(len(a.batch.symbols.to_list()))
+    }
+    lb = {
+        b.batch.symbols.lookup(i + 1): i
+        for i in range(len(b.batch.symbols.to_list()))
+    }
     assert set(la) == set(lb)
     for sym, ia in la.items():
         ib = lb[sym]
@@ -148,7 +153,9 @@ def test_reference_style_store_with_quirks():
     store.execute_command("HSET", link_key, f"{sym}:node:a", node("a", 5e8, None, "b"))
     store.execute_command("HSET", link_key, f"{sym}:node:b", node("b", 3e8, "a", None))
     # leaked entry: unlinked but never HDel'd (the reference's delete bug)
-    store.execute_command("HSET", link_key, f"{sym}:node:leak", node("leak", 7e8, "a", "b"))
+    store.execute_command(
+        "HSET", link_key, f"{sym}:node:leak", node("leak", 7e8, "a", "b")
+    )
     # depth residue: says more than the list holds
     store.execute_command(
         "HSET", f"{sym}:depth", f"{sym}:depth:100000000", "800000001"

@@ -3,7 +3,6 @@ reflection (main.go:33); ours must answer list-services and
 file-containing-symbol the way grpcurl asks them."""
 
 import grpc
-import pytest
 
 from gome_tpu.api import order_pb2 as pb
 from gome_tpu.api.reflection import (

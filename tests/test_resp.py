@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from gome_tpu.engine import BookConfig, MatchEngine
-from gome_tpu.engine.prepool import LocalPrePool, RespPrePool, make_marker
+from gome_tpu.engine.prepool import RespPrePool, make_marker
 from gome_tpu.oracle import OracleEngine
 from gome_tpu.persist import restore_from_redis
 from gome_tpu.persist.redis_schema import export_to_redis

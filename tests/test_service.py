@@ -11,7 +11,7 @@ from gome_tpu.bus import decode_match_result
 from gome_tpu.config import Config, EngineConfig, GrpcConfig
 from gome_tpu.oracle import OracleEngine
 from gome_tpu.service import EngineService
-from gome_tpu.types import MatchResult, Order, OrderSnapshot, Side
+from gome_tpu.types import MatchResult, Order, Side
 
 
 def make_service(**engine_kw):

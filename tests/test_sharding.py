@@ -6,7 +6,6 @@ compiles with the expected zero-collective partitioning."""
 
 import jax
 import numpy as np
-import pytest
 
 from gome_tpu.engine import BatchEngine, BookConfig, batch_step, init_books
 from gome_tpu.engine.book import DeviceOp
