@@ -18,10 +18,19 @@ package checks those contracts *statically*, before a soak test runs:
   GL4xx  lock-discipline   — `# guarded by self._lock` annotations enforced
                              lexically (analysis.locks); the opt-in runtime
                              assertion mode lives in analysis.runtime
+  GL5xx  transfer-hygiene  — host<->device syncs on `# gomelint: hotpath`
+                             reachable code OUTSIDE jit (analysis.transfers,
+                             over the analysis.callgraph hot-path engine)
+  GL6xx  buffer-donation   — jitted entries that double-buffer dead state
+                             arguments, no-op donations, and use-after-
+                             donation call sites (analysis.donation)
 
 Run it via ``python scripts/gomelint.py gome_tpu`` (CI's analysis job) or
 programmatically through :func:`run_paths`. Findings carry stable rule
-ids and ``file:line`` anchors; suppress one line with a trailing
+ids, ``file:line`` anchors, and content-addressed fingerprints
+(analysis.baseline) that drive the CI ratchet — only findings NOT in the
+committed ``analysis/baseline.json`` fail the gate — and the SARIF 2.1.0
+output (analysis.sarif). Suppress one line with a trailing
 ``# gomelint: disable=GL101`` comment, or a whole file with
 ``# gomelint: disable-file=GL101`` on any line (see analysis.core).
 """
@@ -30,18 +39,26 @@ from __future__ import annotations
 
 from .core import (
     ALL_RULES,
+    TOOL_VERSION,
     Finding,
+    Project,
     SourceModule,
     rule_catalogue,
     run_paths,
     run_source,
+    run_sources,
 )
+
+__version__ = TOOL_VERSION
 
 __all__ = [
     "ALL_RULES",
+    "TOOL_VERSION",
     "Finding",
+    "Project",
     "SourceModule",
     "rule_catalogue",
     "run_paths",
     "run_source",
+    "run_sources",
 ]

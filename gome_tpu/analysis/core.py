@@ -25,6 +25,11 @@ import dataclasses
 import os
 import re
 
+#: Tool version (CLI --version, SARIF tool.driver.version, baseline
+#: provenance). Bump on rule-semantics changes: a fingerprint computed by
+#: one major version may legitimately churn under the next.
+TOOL_VERSION = "2.0.0"
+
 #: rule id -> one-line description (the catalogue; checkers register into
 #: this at import time so the CLI's --list-rules stays complete).
 ALL_RULES: dict[str, str] = {}
@@ -106,6 +111,30 @@ def register_checker(family: str, fn) -> None:
     CHECKERS.append((family, fn))
 
 
+#: project-scope checkers: (family, fn); fn(project) -> findings. These see
+#: EVERY module of the run at once — the interprocedural passes (hot-path
+#: reachability, donation call-site liveness) need the whole-package call
+#: graph, which no single-module pass can build.
+PROJECT_CHECKERS: list[tuple[str, object]] = []
+
+
+def register_project_checker(family: str, fn) -> None:
+    PROJECT_CHECKERS.append((family, fn))
+
+
+class Project:
+    """One analysis run's worth of parsed modules plus per-module
+    suppression routing for project-scope findings."""
+
+    def __init__(self, modules: list[SourceModule]):
+        self.modules = list(modules)
+        self.by_path = {m.path: m for m in self.modules}
+
+    def suppressed(self, rule: str, path: str, line: int) -> bool:
+        m = self.by_path.get(path)
+        return m is not None and m.suppressed(rule, line)
+
+
 def _selected(rule: str, select: set[str] | None) -> bool:
     if not select:
         return True
@@ -131,16 +160,47 @@ def _collect(module: SourceModule, select: set[str] | None,
 
 def _ensure_checkers_loaded() -> None:
     # Import-time registration; local imports avoid a hard cycle.
-    from . import locks, recompile, trace_safety  # noqa: F401
+    from . import donation, locks, recompile, trace_safety, transfers  # noqa: F401
+
+
+def _run_project(modules: list[SourceModule], select: set[str] | None,
+                 keep_suppressed: bool) -> list[Finding]:
+    """Module checkers per module + project checkers over the whole set."""
+    findings: list[Finding] = []
+    for module in modules:
+        findings.extend(_collect(module, select, keep_suppressed))
+    project = Project(modules)
+    for family, fn in PROJECT_CHECKERS:
+        if select and not any(s.startswith(family) or family.startswith(s)
+                              for s in select):
+            continue
+        for f in fn(project):
+            if not _selected(f.rule, select):
+                continue
+            if not keep_suppressed and project.suppressed(f.rule, f.path,
+                                                          f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
 
 
 def run_source(text: str, path: str = "<memory>",
                select: set[str] | None = None,
                keep_suppressed: bool = False) -> list[Finding]:
     """Analyze one source string (golden-fixture tests use this)."""
+    return run_sources({path: text}, select, keep_suppressed)
+
+
+def run_sources(sources: dict[str, str], select: set[str] | None = None,
+                keep_suppressed: bool = False) -> list[Finding]:
+    """Analyze a set of in-memory modules as ONE project — the fixture
+    surface for the interprocedural passes (cross-module hot-path
+    reachability needs at least two modules to mean anything)."""
     _ensure_checkers_loaded()
     sel = {s.upper() for s in select} if select else None
-    return _collect(SourceModule(path, text), sel, keep_suppressed)
+    modules = [SourceModule(path, text) for path, text in sources.items()]
+    return _run_project(modules, sel, keep_suppressed)
 
 
 def iter_python_files(paths: list[str]) -> list[str]:
@@ -162,24 +222,49 @@ def iter_python_files(paths: list[str]) -> list[str]:
     return out
 
 
+def apply_file_suppressions(findings: list[Finding],
+                            root: str = "") -> list[Finding]:
+    """Drop findings silenced by ``# gomelint: disable`` directives in
+    their anchor files. The jaxpr-driven audits (GL2xx/GL6xx) produce
+    findings outside the module-checker pipeline, so the CLI routes them
+    through this to honor the same suppression syntax."""
+    cache: dict[str, SourceModule | None] = {}
+    out: list[Finding] = []
+    for f in findings:
+        path = f.path
+        if root and not os.path.isabs(path):
+            path = os.path.join(root, path)
+        if path not in cache:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    cache[path] = SourceModule(path, fh.read())
+            except (OSError, SyntaxError):
+                cache[path] = None
+        mod = cache[path]
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    return out
+
+
 def run_paths(paths: list[str], select: set[str] | None = None,
               keep_suppressed: bool = False) -> list[Finding]:
-    """Analyze files/directories; returns sorted findings."""
+    """Analyze files/directories as one project; returns sorted findings."""
     _ensure_checkers_loaded()
     sel = {s.upper() for s in select} if select else None
     findings: list[Finding] = []
+    modules: list[SourceModule] = []
     for path in iter_python_files(paths):
         with open(path, encoding="utf-8") as fh:
             text = fh.read()
         try:
-            module = SourceModule(path, text)
+            modules.append(SourceModule(path, text))
         except SyntaxError as e:
             findings.append(Finding(
                 "GL000", path, e.lineno or 1, e.offset or 0,
                 f"syntax error: {e.msg}",
             ))
-            continue
-        findings.extend(_collect(module, sel, keep_suppressed))
+    findings.extend(_run_project(modules, sel, keep_suppressed))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 

@@ -1,0 +1,477 @@
+"""GL6xx buffer-donation: double-buffered device state + donation misuse.
+
+An undonated jitted step double-buffers every output-sized array: XLA
+must materialize the new books next to the old ones, so the steady-state
+HBM footprint (and allocator traffic) of `books' = step(books, ops)` is
+2x the book stack — the dtype knob halves book bytes for exactly this
+kind of win, and donation gets it back for free where the input really
+is dead. This family audits the *declared* donation policy of the
+engine's jitted entry points against the shapes that actually flow
+through them (alongside the GL2xx envelope audit, which walks the same
+traced jaxprs — the trace work is shared, see envelope.traced_entries):
+
+  GL601  a non-static argument whose buffers could ALL be reused by the
+         call's outputs (same shape/dtype, materially sized) is not
+         donated — the call silently double-buffers it
+  GL602  donate_argnums names an argument none of whose buffers any
+         output can reuse — the donation is a silent no-op (XLA warns
+         and simply frees it)
+  GL603  a value passed in a donated position is read again after the
+         call — on donation-supporting backends that raises "Array has
+         been deleted" at runtime; statically it means the argument was
+         NOT dead and must not be donated (AST call-site liveness check)
+
+GL601 is a *candidate* report, not a command: the engine deliberately
+keeps the pre-grid book stack alive for escalation replay and the
+transactional rollback (batch.BatchEngine._run_exact/_checkpoint), so
+its `books` arguments carry line suppressions documenting that retention
+— the finding records the cost, the suppression records the reason.
+Arguments below ``min_fraction`` (default 10%) of the output bytes are
+ignored: donating a [R] lane-id vector saves nothing and the report
+should name the buffers that matter.
+
+GL601/GL602 need real avals; fixture tests drive :func:`audit_donation`
+with synthetic ``(shape, dtype)`` leaves, while the CLI's ``--jaxpr``
+pass drives :func:`check_engine_donation` with the engine's entries.
+GL603 is a pure-AST project checker and runs with the default rules.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter
+
+from . import callgraph
+from .core import Finding, register_project_checker, register_rules
+from .trace_safety import _const_int_tuple, _is_jit_expr, _is_partial
+
+register_rules({
+    "GL601": "dead same-shape argument of a jitted entry is not donated "
+             "(silent double-buffer)",
+    "GL602": "donate_argnums names an argument no output buffer can reuse",
+    "GL603": "value passed in a donated position is used after the call",
+})
+
+
+# --- jit wrapper spec extraction (shared by the audit and GL603) ---------
+
+def _kw_int_tuple(call: ast.Call, name: str) -> tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return _const_int_tuple(kw.value)
+    return ()
+
+
+def _spec_of_call(call: ast.Call) -> tuple[tuple, tuple] | None:
+    """(static_argnums, donate_argnums) of a jit-constructing Call:
+    ``jax.jit(f, ...)`` / ``functools.partial(jax.jit, ...)``."""
+    if _is_jit_expr(call.func):
+        return (_kw_int_tuple(call, "static_argnums"),
+                _kw_int_tuple(call, "donate_argnums"))
+    if _is_partial(call.func) and call.args and _is_jit_expr(call.args[0]):
+        return (_kw_int_tuple(call, "static_argnums"),
+                _kw_int_tuple(call, "donate_argnums"))
+    return None
+
+
+def wrapper_jit_spec(tree: ast.AST, name: str):
+    """Find the jit spec of wrapper `name` in a module tree: a decorated
+    ``def name`` or a ``name = <jit-or-partial>(impl)`` assignment.
+    Returns (static_argnums, donate_argnums, lineno) or None."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    spec = _spec_of_call(dec)
+                    if spec is not None:
+                        return (*spec, node.lineno)
+                elif _is_jit_expr(dec):
+                    return ((), (), node.lineno)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if not any(isinstance(t, ast.Name) and t.id == name
+                       for t in node.targets):
+                continue
+            value = node.value
+            # direct form: name = jax.jit(impl, donate_argnums=...)
+            spec = _spec_of_call(value)
+            if spec is not None and value.args:
+                return (*spec, node.lineno)
+            # curried form: name = partial(jax.jit, ...)(impl)
+            if isinstance(value.func, ast.Call):
+                spec = _spec_of_call(value.func)
+                if spec is not None:
+                    return (*spec, node.lineno)
+    return None
+
+
+# --- GL601/GL602: the aval-level audit -----------------------------------
+
+def _leaf_bytes(leaf: tuple) -> int:
+    import numpy as np
+
+    shape, dtype = leaf
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * np.dtype(dtype).itemsize
+
+
+def audit_donation(
+    context: str,
+    args: list,
+    static_argnums: tuple,
+    donate_argnums: tuple,
+    out_avals: list,
+    params: list | None = None,
+    path: str = "",
+    line: int = 0,
+    min_fraction: float = 0.10,
+) -> list[Finding]:
+    """Audit one jitted entry's donation policy.
+
+    args: per-argument lists of ``(shape, dtype)`` leaves (None for
+    arguments with no array leaves, e.g. static configs). out_avals: the
+    flat ``(shape, dtype)`` list of the traced call's outputs. Matching
+    is multiset-aware: donated arguments claim their output buffers
+    first; each remaining argument is then judged independently against
+    the leftover pool."""
+    findings: list[Finding] = []
+    norm_out = [_norm_leaf(a) for a in out_avals]
+    pool = Counter(norm_out)
+    out_bytes = sum(_leaf_bytes(a) for a in norm_out) or 1
+
+    def pname(i: int) -> str:
+        if params and i < len(params):
+            return f"#{i} ({params[i]!r})"
+        return f"#{i}"
+
+    # donated args claim their matches (and reveal GL602 no-ops)
+    for i in donate_argnums:
+        leaves = args[i] if i < len(args) else None
+        if not leaves:
+            continue
+        matched = 0
+        for leaf in map(_norm_leaf, leaves):
+            if pool[leaf] > 0:
+                pool[leaf] -= 1
+                matched += 1
+        if matched == 0:
+            findings.append(Finding(
+                "GL602", path, line, 0,
+                f"{context}: donated argument {pname(i)} matches no output "
+                "buffer (shape/dtype mismatch) — the donation is a silent "
+                "no-op and the buffer is simply freed",
+            ))
+
+    for i, leaves in enumerate(args):
+        if i in static_argnums or i in donate_argnums or not leaves:
+            continue
+        norm = [_norm_leaf(x) for x in leaves]
+        trial = Counter(pool)
+        usable = True
+        for leaf in norm:
+            if trial[leaf] <= 0:
+                usable = False
+                break
+            trial[leaf] -= 1
+        if not usable:
+            continue
+        arg_bytes = sum(_leaf_bytes(x) for x in norm)
+        if arg_bytes < min_fraction * out_bytes:
+            continue
+        findings.append(Finding(
+            "GL601", path, line, 0,
+            f"{context}: argument {pname(i)} ({arg_bytes}B of buffers, all "
+            "reusable by the outputs) is not donated — every call "
+            "double-buffers it; add donate_argnums (or suppress with the "
+            "liveness reason)",
+        ))
+    return findings
+
+
+def _norm_leaf(leaf) -> tuple:
+    shape, dtype = leaf
+    return (tuple(int(d) for d in shape), str(dtype))
+
+
+def _arg_leaves(tree):
+    """Example pytree -> [(shape, dtype)] for array leaves; None if the
+    argument carries no arrays (static config, python scalars)."""
+    import jax
+
+    leaves = [
+        (tuple(x.shape), str(x.dtype))
+        for x in jax.tree_util.tree_leaves(tree)
+        if hasattr(x, "shape") and hasattr(x, "dtype")
+    ]
+    return leaves or None
+
+
+#: The audited engine wrappers: (module_rel, wrapper_name, trace_context,
+#: arg_map, params). Several wrappers share one traced graph — the public
+#: entry, its `_donating` twin, and the Pallas kernel variants (identical
+#: output avals by construction) differ only in signature layout and
+#: donate_argnums, which come from the AST. arg_map maps wrapper arg
+#: positions to the trace record's example args (None = non-array static,
+#: e.g. block_s/interpret).
+_ENGINE_WRAPPERS = [
+    ("engine/step.py", "step", "engine/step.py:step_impl",
+     [0, 1, 2], ["config", "book", "op"]),
+    ("engine/batch.py", "batch_step", "engine/batch.py:batch_step",
+     [0, 1, 2], ["config", "books", "ops"]),
+    ("engine/batch.py", "batch_step_donating", "engine/batch.py:batch_step",
+     [0, 1, 2], ["config", "books", "ops"]),
+    ("engine/batch.py", "dense_batch_step",
+     "engine/batch.py:dense_batch_step",
+     [0, 1, 2, 3], ["config", "books", "lane_ids", "ops"]),
+    ("engine/batch.py", "dense_batch_step_donating",
+     "engine/batch.py:dense_batch_step",
+     [0, 1, 2, 3], ["config", "books", "lane_ids", "ops"]),
+    ("engine/batch.py", "lane_scan", "engine/batch.py:lane_scan",
+     [0, 1, 2], ["config", "book", "ops_lane"]),
+    ("engine/batch.py", "lane_scan_donating", "engine/batch.py:lane_scan",
+     [0, 1, 2], ["config", "book", "ops_lane"]),
+    ("engine/batch.py", "full_kernel_step", "engine/batch.py:batch_step",
+     [0, 1, 2, None, None],
+     ["config", "books", "ops", "block_s", "interpret"]),
+    ("engine/batch.py", "full_kernel_step_donating",
+     "engine/batch.py:batch_step",
+     [0, 1, 2, None, None],
+     ["config", "books", "ops", "block_s", "interpret"]),
+    ("engine/batch.py", "dense_kernel_step",
+     "engine/batch.py:dense_batch_step",
+     [0, 1, 2, 3, None, None],
+     ["config", "books", "lane_ids", "ops", "block_s", "interpret"]),
+    ("engine/batch.py", "dense_kernel_step_donating",
+     "engine/batch.py:dense_batch_step",
+     [0, 1, 2, 3, None, None],
+     ["config", "books", "lane_ids", "ops", "block_s", "interpret"]),
+]
+
+
+def check_engine_donation(dtype: str = "int32") -> list[Finding]:
+    """Audit the engine's jitted step/batch entry points (CLI --jaxpr).
+    Reuses the jaxprs the GL2xx envelope audit already traced — the
+    shared memo in envelope.traced_entries keeps the CI analysis job at
+    one trace per entry for both families."""
+    import os
+
+    from .envelope import traced_entries
+
+    records = {rec["context"]: rec for rec in traced_entries(dtype)}
+    findings: list[Finding] = []
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tree_cache: dict[str, ast.AST] = {}
+    for rel, wrapper, context, arg_map, params in _ENGINE_WRAPPERS:
+        rec = records.get(context)
+        if rec is None or "args" not in rec:
+            continue
+        path = os.path.join(root, rel)
+        if rel not in tree_cache:
+            with open(path, encoding="utf-8") as fh:
+                tree_cache[rel] = ast.parse(fh.read(), filename=path)
+        spec = wrapper_jit_spec(tree_cache[rel], wrapper)
+        if spec is None:
+            continue  # wrapper vanished; the table is stale — skip
+        static, donate, lineno = spec
+        closed = rec["closed"]
+        out_avals = [
+            (tuple(v.aval.shape), str(v.aval.dtype))
+            for v in closed.jaxpr.outvars
+            if hasattr(getattr(v, "aval", None), "shape")
+        ]
+        example = rec["args"]
+        args = [
+            None if src is None else _arg_leaves(example[src])
+            for src in arg_map
+        ]
+        findings.extend(audit_donation(
+            context=f"gome_tpu/{rel}:{wrapper}",
+            args=args,
+            static_argnums=static,
+            donate_argnums=donate,
+            out_avals=out_avals,
+            params=params,
+            path=f"gome_tpu/{rel}",
+            line=lineno,
+        ))
+    return findings
+
+
+# --- GL603: call-site use-after-donation (pure AST, project scope) -------
+
+class _DonatingRegistry:
+    """name -> [(module, is_module_level, donated positions)] for every
+    jit wrapper with a non-empty donate_argnums in the project. Matching
+    is by bare name, scoped: a wrapper defined INSIDE a function (a local
+    like bench.py's `stepper`) only matches calls in its own module —
+    an unrelated same-named local elsewhere is not it; module-level
+    wrappers are importable and match project-wide."""
+
+    def __init__(self, project):
+        self.donate: dict[str, list[tuple[object, bool, tuple]]] = {}
+        for module in project.modules:
+            top = set(module.tree.body)
+            for cls in module.tree.body:
+                if isinstance(cls, ast.ClassDef):
+                    top |= set(cls.body)
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if isinstance(dec, ast.Call):
+                            spec = _spec_of_call(dec)
+                            if spec and spec[1]:
+                                self._add(node.name, module,
+                                          node in top, spec[1])
+                elif isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    # curried: x = partial(jax.jit, ...)(impl);
+                    # direct:  x = jax.jit(impl, donate_argnums=...)
+                    spec = None
+                    if isinstance(node.value.func, ast.Call):
+                        spec = _spec_of_call(node.value.func)
+                    if spec is None and node.value.args:
+                        spec = _spec_of_call(node.value)
+                    if spec and spec[1]:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self._add(t.id, module, node in top,
+                                          spec[1])
+
+    def _add(self, name, module, module_level, donate) -> None:
+        self.donate.setdefault(name, []).append(
+            (module, module_level, donate)
+        )
+
+    def lookup(self, name: str, module) -> tuple[int, ...] | None:
+        """Donated positions of `name` as callable from `module`; the
+        union over matching definitions (conservative)."""
+        out: set[int] = set()
+        for mod, module_level, donate in self.donate.get(name, ()):
+            if mod is module or module_level:
+                out.update(donate)
+        return tuple(sorted(out)) or None
+
+
+class _LivenessScan(ast.NodeVisitor):
+    """One function body: collect Name load/store events and calls into
+    donating wrappers, then flag donated names that are read again after
+    the call without an intervening rebind (lexical liveness — the same
+    approximation the GL4xx lock checker makes, documented there)."""
+
+    def __init__(self, registry: _DonatingRegistry, fn: callgraph.FuncNode):
+        self.reg = registry
+        self.fn = fn
+        self.events: list[tuple[int, int, str, bool]] = []  # line,col,name,is_store
+        self.calls: list[tuple[ast.Call, int, set[str], tuple]] = []
+        self._rebinds: list[set[str]] = []
+        self._in_return = 0
+
+    def visit_FunctionDef(self, node):
+        if node is not self.fn.node:
+            return
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        if node is self.fn.node:
+            self.visit(node.body)
+
+    def visit_Name(self, node):
+        self.events.append((
+            node.lineno, node.col_offset, node.id,
+            isinstance(node.ctx, (ast.Store, ast.Del)),
+        ))
+
+    def _targets(self, targets) -> set[str]:
+        names: set[str] = set()
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+        return names
+
+    def visit_Assign(self, node):
+        self._rebinds.append(self._targets(node.targets))
+        self.visit(node.value)
+        self._rebinds.pop()
+        for t in node.targets:
+            self.visit(t)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._rebinds.append(self._targets([node.target]))
+            self.visit(node.value)
+            self._rebinds.pop()
+        self.visit(node.target)
+
+    def visit_Return(self, node):
+        # `return f(x, ...)` ends the frame: nothing after it can read a
+        # donated argument on THIS path, and lexically-later reads belong
+        # to other branches.
+        self._in_return += 1
+        self.generic_visit(node)
+        self._in_return -= 1
+
+    def visit_Call(self, node):
+        name = None
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            name = node.func.attr
+        donate = (
+            None if self._in_return
+            else self.reg.lookup(name or "", self.fn.module)
+        )
+        if donate:
+            rebound = set().union(*self._rebinds) if self._rebinds else set()
+            end = getattr(node, "end_lineno", node.lineno)
+            self.calls.append((node, end, rebound, donate))
+        self.generic_visit(node)
+
+    def run(self) -> list[Finding]:
+        self.visit(self.fn.node)
+        findings: list[Finding] = []
+        for call, end, rebound, donate in self.calls:
+            name = (call.func.id if isinstance(call.func, ast.Name)
+                    else call.func.attr)
+            for pos in donate:
+                if pos >= len(call.args):
+                    continue
+                arg = call.args[pos]
+                if not isinstance(arg, ast.Name):
+                    continue
+                if arg.id in rebound:
+                    continue  # x, ... = f(..., x, ...): the rebind IS death
+                nxt = min(
+                    (ev for ev in self.events
+                     if ev[2] == arg.id and ev[0] > end),
+                    default=None,
+                )
+                if nxt is not None and not nxt[3]:
+                    findings.append(Finding(
+                        "GL603", self.fn.module.path, nxt[0], nxt[1],
+                        f"{arg.id!r} was passed in donated position {pos} "
+                        f"of {name}() on line {call.lineno} and is read "
+                        "again here — the donated buffer is deleted by the "
+                        "call (runtime 'Array has been deleted'); rebind "
+                        "or stop donating",
+                    ))
+        return findings
+
+
+def check_use_after_donation(project) -> list[Finding]:
+    registry = _DonatingRegistry(project)
+    if not registry.donate:
+        return []
+    graph = callgraph.build(project)
+    findings: list[Finding] = []
+    for fn in graph.funcs:
+        findings.extend(_LivenessScan(registry, fn).run())
+    return findings
+
+
+register_project_checker("GL6", check_use_after_donation)

@@ -135,30 +135,45 @@ def check_jaxpr(closed, declared_dtype: str, context: str,
     return findings
 
 
-def engine_entry_jaxprs(dtype: str = "int32"):
-    """Trace the engine's device entry points with small geometry; yields
-    (context_name, closed_jaxpr). Imports jax lazily — the pure-AST
-    checkers must not pay for it.
+#: Per-dtype memo of the traced entry records: the GL2xx envelope walk
+#: and the GL6xx donation audit both consume these, and the host trace
+#: (~seconds on CPU) must be paid once per CLI/CI run, not per family.
+_TRACE_CACHE: dict[str, list[dict]] = {}
+
+
+def traced_entries(dtype: str = "int32") -> list[dict]:
+    """Trace the engine's device entry points with small geometry ONCE
+    per dtype; returns records ``{"context", "closed", "args"?,
+    "params"?, "wrappers"?}``. Imports jax lazily — the pure-AST checkers
+    must not pay for it.
 
     Tracing runs under the dtype's NATIVE x64 mode (int32 books deploy
     with x64 off; int64 books require it — engine/book.py flips it).
     Auditing an int32 graph traced under x64-on would drown the report in
     jnp.sum's int32→int64 promotion, which the deployment configuration
     never executes."""
-    from jax.experimental import enable_x64, disable_x64
+    if dtype not in _TRACE_CACHE:
+        from jax.experimental import enable_x64, disable_x64
 
-    ctx = enable_x64 if dtype == "int64" else disable_x64
-    with ctx():
-        yield from _entry_jaxprs_x64_scoped(dtype)
+        ctx = enable_x64 if dtype == "int64" else disable_x64
+        with ctx():
+            _TRACE_CACHE[dtype] = list(_entry_records_x64_scoped(dtype))
+    return _TRACE_CACHE[dtype]
 
 
-def _entry_jaxprs_x64_scoped(dtype: str):
+def engine_entry_jaxprs(dtype: str = "int32"):
+    """Back-compat view of traced_entries: (context, closed_jaxpr)."""
+    for rec in traced_entries(dtype):
+        yield rec["context"], rec["closed"]
+
+
+def _entry_records_x64_scoped(dtype: str):
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from ..engine import frames as fr
-    from ..engine.batch import batch_step, dense_batch_step
+    from ..engine.batch import _lane_scan_impl, batch_step, dense_batch_step
     from ..engine.book import BookConfig, DeviceOp, init_books
     from ..engine.step import step_impl
 
@@ -174,15 +189,38 @@ def _entry_jaxprs_x64_scoped(dtype: str):
     })
     one_book = jax.tree.map(lambda a: a[0], books)
     one_op = jax.tree.map(lambda a: a[0, 0], op_grid)
+    ops_lane = jax.tree.map(lambda a: a[0], op_grid)
 
-    yield "engine/step.py:step_impl", jax.make_jaxpr(
-        lambda b, o: step_impl(config, b, o))(one_book, one_op)
-    yield "engine/batch.py:batch_step", jax.make_jaxpr(
-        lambda b, o: batch_step(config, b, o))(books, op_grid)
+    yield dict(
+        context="engine/step.py:step_impl",
+        closed=jax.make_jaxpr(
+            lambda b, o: step_impl(config, b, o))(one_book, one_op),
+        args=(config, one_book, one_op),
+        params=["config", "book", "op"],
+    )
+    yield dict(
+        context="engine/batch.py:batch_step",
+        closed=jax.make_jaxpr(
+            lambda b, o: batch_step(config, b, o))(books, op_grid),
+        args=(config, books, op_grid),
+        params=["config", "books", "ops"],
+    )
     lane_ids = jnp.zeros((s,), jnp.int32)
-    yield "engine/batch.py:dense_batch_step", jax.make_jaxpr(
-        lambda b, l_, o: dense_batch_step(config, b, l_, o)
-    )(books, lane_ids, op_grid)
+    yield dict(
+        context="engine/batch.py:dense_batch_step",
+        closed=jax.make_jaxpr(
+            lambda b, l_, o: dense_batch_step(config, b, l_, o)
+        )(books, lane_ids, op_grid),
+        args=(config, books, lane_ids, op_grid),
+        params=["config", "books", "lane_ids", "ops"],
+    )
+    yield dict(
+        context="engine/batch.py:lane_scan",
+        closed=jax.make_jaxpr(
+            lambda b, o: _lane_scan_impl(config, b, o))(one_book, ops_lane),
+        args=(config, one_book, ops_lane),
+        params=["config", "book", "ops_lane"],
+    )
 
     # frame compaction accumulator (the fast-path event path)
     from ..engine.book import StepOutput
@@ -200,25 +238,33 @@ def _entry_jaxprs_x64_scoped(dtype: str):
     fills_acc = jnp.zeros((len(fr._FILL_FIELDS), 64), wide)
     cancels_acc = jnp.zeros((len(fr._CANCEL_FIELDS), 64), wide)
     totals_acc = jnp.zeros((8, 4), jnp.int32)
-    yield "engine/frames.py:compact_accum", jax.make_jaxpr(
-        lambda o, f, c, tt: fr.compact_accum(config, o, f, c, tt,
-                                             np.int32(0))
-    )(outs, fills_acc, cancels_acc, totals_acc)
+    yield dict(
+        context="engine/frames.py:compact_accum",
+        closed=jax.make_jaxpr(
+            lambda o, f, c, tt: fr.compact_accum(config, o, f, c, tt,
+                                                 np.int32(0))
+        )(outs, fills_acc, cancels_acc, totals_acc),
+    )
 
     # device-side grid scatter-builder
     scatter = fr._scatter_grid_fn(dt.name, 2, 4)
     cols = jnp.zeros((7, 64), dt)
     flat = jnp.full((64,), 8, jnp.int32)
-    yield "engine/frames.py:_scatter_grid_fn", jax.make_jaxpr(scatter)(
-        cols, flat)
+    yield dict(
+        context="engine/frames.py:_scatter_grid_fn",
+        closed=jax.make_jaxpr(scatter)(cols, flat),
+    )
 
     # Pallas kernel, interpret mode (same jaxpr the TPU lowering consumes)
     try:
         from ..ops.pallas_match import pallas_batch_step
-        yield "ops/pallas_match.py:pallas_batch_step", jax.make_jaxpr(
-            lambda b, o: pallas_batch_step(config, b, o, block_s=2,
-                                           interpret=True)
-        )(books, op_grid)
+        yield dict(
+            context="ops/pallas_match.py:pallas_batch_step",
+            closed=jax.make_jaxpr(
+                lambda b, o: pallas_batch_step(config, b, o, block_s=2,
+                                               interpret=True)
+            )(books, op_grid),
+        )
     except Exception:  # pragma: no cover - interpret support varies
         pass
 

@@ -1,0 +1,402 @@
+"""GL5xx transfer-hygiene: host↔device traffic on annotated hot paths.
+
+The engine's throughput story is "book state lives on device; the host
+ships one batched grid down and one batched fetch up per frame". A single
+`.item()` on a per-order value, an implicit `bool()` on a jax array in a
+conditional, or a `device_put` inside the packing loop silently
+reintroduces the per-order round trip the whole design deletes — JAX-LOB
+(arXiv:2308.13289) and CoinTossX (arXiv:2102.10925) both report
+end-to-end throughput gated by exactly these leaks, not kernel FLOPs.
+
+These rules run OUTSIDE jit, on the host functions reachable from a
+``# gomelint: hotpath`` seed (analysis.callgraph); inside traced code the
+same idioms are GL1xx's domain. The rules:
+
+  GL501  blocking scalar fetch: ``.item()``/``.tolist()``/``float()``/
+         ``int()``/``complex()`` on a device value (one device→host sync
+         each — per order, that is the Redis round trip again)
+  GL502  host materialization: a ``np.*`` call on a device value
+         (``np.asarray``/``np.array``/any ufunc syncs via ``__array__``)
+  GL503  implicit bool sync: ``if``/``while``/``assert``/ternary/
+         ``bool()``/iteration on a device value (truthiness forces a
+         blocking fetch of the whole predicate)
+  GL504  ``block_until_ready()`` inside a loop (serializes the device
+         pipeline per iteration; drain once per batch instead)
+  GL505  host→device transfer (``jax.device_put``/``jnp.asarray``/
+         ``jnp.array`` of a host value) inside a loop (per-iteration
+         upload; hoist or batch the transfer)
+
+Device-taint model (documented limits — a linter, not an interpreter):
+
+  * values returned by jit/pallas-wrapped functions are DEVICE; the bit
+    propagates interprocedurally (a helper whose ``return`` is device
+    makes its callers' results device), through arithmetic, subscripts,
+    attribute access, tuple unpacking, and ``jax.tree.*`` maps;
+  * ``jnp.*`` calls and ``jax.device_put`` produce DEVICE values;
+  * ``jax.device_get(x)`` and ``np.asarray(x)`` produce HOST values (the
+    latter still flags GL502 when x was device — it is the sanctioned
+    fetch only via device_get, which batches and is loggable);
+  * ``.shape``/``.dtype``/``len()`` and friends are metadata — they
+    de-taint (reading an aval never syncs);
+  * parameters, ``self`` attributes, and unresolved calls are UNKNOWN
+    (untainted): the pass under-reports rather than spamming — the grep
+    surface for what it can miss is the ``# gomelint: hotpath`` seeds.
+
+GL504/GL505 are *lexically* loop-scoped within one function; a transfer
+in a helper called from a loop is only caught if the helper itself loops.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import callgraph
+from .core import Finding, register_project_checker, register_rules
+from .trace_safety import _STATIC_ATTRS, _dotted
+
+register_rules({
+    "GL501": "blocking scalar fetch (.item()/float()/int()) of a device "
+             "value on a hot path",
+    "GL502": "numpy materialization of a device value on a hot path",
+    "GL503": "implicit bool() sync on a device value on a hot path",
+    "GL504": "block_until_ready() inside a loop on a hot path",
+    "GL505": "host->device transfer inside a loop on a hot path",
+})
+
+_SCALAR_CASTS = {"float", "int", "complex"}
+_DETAINT_CALLS = {"len", "isinstance", "type", "id", "repr", "str", "hash",
+                  "bool"}
+_HOST_PRODUCERS = {"device_get"}  # leaf names under jax.*
+_TRANSFER_LEAVES = {"device_put", "asarray", "array"}
+
+
+class _FnFacts:
+    __slots__ = ("returns_device",)
+
+    def __init__(self):
+        self.returns_device = False
+
+
+class _Scan(ast.NodeVisitor):
+    """One function body's device-taint scan. emit=False runs are the
+    returns-device fixpoint; emit=True runs report findings (hot
+    functions only)."""
+
+    def __init__(self, checker: "_Checker", fn: callgraph.FuncNode,
+                 emit: bool):
+        self.c = checker
+        self.fn = fn
+        self.emit = emit
+        self.taint: dict[str, bool] = {}
+        self.loop_depth = 0
+        self.returns_device = False
+        self.findings: list[Finding] = []
+
+    # -- expression taint --------------------------------------------------
+    def t(self, node: ast.AST | None) -> bool:
+        if node is None:
+            return False
+        method = getattr(self, f"_t_{type(node).__name__}", None)
+        if method is not None:
+            return method(node)
+        out = False
+        for child in ast.iter_child_nodes(node):
+            out = self.t(child) or out
+        return out
+
+    def _t_Name(self, node):
+        return self.taint.get(node.id, False)
+
+    def _t_Constant(self, node):
+        return False
+
+    def _t_Lambda(self, node):
+        return False
+
+    def _t_Attribute(self, node):
+        if node.attr in _STATIC_ATTRS:
+            self.t(node.value)
+            return False
+        return self.t(node.value)
+
+    def _t_Subscript(self, node):
+        return self.t(node.value) or self.t(node.slice)
+
+    def _t_IfExp(self, node):
+        if self.t(node.test):
+            self._report("GL503", node,
+                         "ternary condition on a device value (blocking "
+                         "truthiness fetch)")
+        return self.t(node.body) or self.t(node.orelse)
+
+    def _t_Compare(self, node):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False  # identity tests never materialize
+        out = self.t(node.left)
+        for cmp_ in node.comparators:
+            out = self.t(cmp_) or out
+        return out
+
+    def _t_BoolOp(self, node):
+        # `x and y` forces bool(x): same sync as an `if`.
+        for v in node.values[:-1]:
+            if self.t(v):
+                self._report("GL503", v,
+                             "and/or on a device value (forces bool())")
+        return any(self.t(v) for v in node.values)
+
+    def _t_Call(self, node):
+        fname = _dotted(node.func) or ""
+        leaf = fname.rsplit(".", 1)[-1]
+        root = fname.split(".", 1)[0]
+        arg_dev = any(self.t(a) for a in node.args) | any(
+            self.t(k.value) for k in node.keywords
+        )
+
+        # receiver-method syncs
+        if isinstance(node.func, ast.Attribute):
+            recv = self.t(node.func.value)
+            if node.func.attr in ("item", "tolist") and recv:
+                self._report(
+                    "GL501", node,
+                    f".{node.func.attr}() is a blocking device->host "
+                    "scalar fetch — batch it through one device_get",
+                )
+                return False
+            if node.func.attr == "block_until_ready":
+                if self.loop_depth and self.fn.hot and not self.fn.jitted:
+                    self._report(
+                        "GL504", node,
+                        "block_until_ready() inside a loop serializes the "
+                        "device pipeline per iteration — drain once per "
+                        "batch/frame",
+                    )
+                return recv or arg_dev
+
+        if fname in _SCALAR_CASTS:
+            if arg_dev:
+                self._report(
+                    "GL501", node,
+                    f"{fname}() on a device value is a blocking scalar "
+                    "fetch — device_get the batch once instead",
+                )
+            return False
+        if fname == "bool":
+            if arg_dev:
+                self._report("GL503", node,
+                             "bool() on a device value is a blocking sync")
+            return False
+        if fname in _DETAINT_CALLS:
+            return False
+
+        if root in ("np", "numpy"):
+            if arg_dev:
+                self._report(
+                    "GL502", node,
+                    f"{fname}() materializes a device value on the host "
+                    "(implicit __array__ sync) — fetch via jax.device_get "
+                    "at the batch boundary",
+                )
+            return False
+
+        if root in ("jnp", "jax"):
+            if leaf in _HOST_PRODUCERS:
+                return False  # device_get: the sanctioned batched fetch
+            if leaf in _TRANSFER_LEAVES and (root == "jnp"
+                                             or leaf == "device_put"):
+                if self.loop_depth and not arg_dev and self.fn.hot \
+                        and not self.fn.jitted:
+                    self._report(
+                        "GL505", node,
+                        f"{fname}() inside a loop uploads host data to the "
+                        "device per iteration — hoist or batch the "
+                        "transfer",
+                    )
+                return True
+            if root == "jnp" or fname.startswith("jax.numpy"):
+                return True  # jnp.* produce device arrays
+            # jax.tree.map and friends: taint follows the arguments
+            return arg_dev
+
+        # calls into project functions: device iff the target returns device
+        out = False
+        for target in self._resolve(node):
+            if target.jitted or self.c.facts[target].returns_device:
+                out = True
+        # a method call on a device receiver stays device (`outs.sum()`,
+        # `books._replace(...)`, `.at[...].set(...)`)
+        if isinstance(node.func, ast.Attribute):
+            out = out or self.t(node.func.value)
+        return out
+
+    def _resolve(self, node: ast.Call) -> list[callgraph.FuncNode]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return self.c.graph.resolve_name(func.id, self.fn)
+        if isinstance(func, ast.Attribute):
+            return self.c.graph.resolve_method(func.attr, self.fn)
+        return []
+
+    # -- statements --------------------------------------------------------
+    def _assign(self, target, taint: bool) -> None:
+        if isinstance(target, ast.Name):
+            self.taint[target.id] = taint
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._assign(el, taint)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, taint)
+
+    def visit_Assign(self, node):
+        t = self.t(node.value)
+        for target in node.targets:
+            self._assign(target, t)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._assign(node.target, self.t(node.value))
+
+    def visit_AugAssign(self, node):
+        t = self.t(node.value)
+        if isinstance(node.target, ast.Name):
+            self.taint[node.target.id] = (
+                self.taint.get(node.target.id, False) or t
+            )
+
+    def visit_If(self, node):
+        if self.t(node.test):
+            self._report("GL503", node.test,
+                         "`if` on a device value blocks on the predicate "
+                         "fetch — fetch the batch once, branch on numpy")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self.t(node.test):
+            self._report("GL503", node.test,
+                         "`while` on a device value syncs per iteration")
+        self.loop_depth += 1
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        self.loop_depth -= 1
+
+    def visit_Assert(self, node):
+        if self.t(node.test):
+            self._report("GL503", node.test,
+                         "`assert` on a device value is a blocking sync "
+                         "(and python -O strips it)")
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        it_dev = self.t(node.iter)
+        if it_dev:
+            self._report(
+                "GL503", node.iter,
+                "`for` over a device value fetches one element per "
+                "iteration — device_get once and iterate the numpy copy",
+            )
+        self._assign(node.target, it_dev)
+        self.loop_depth += 1
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        self.loop_depth -= 1
+
+    def visit_Return(self, node):
+        if node.value is not None and self.t(node.value):
+            self.returns_device = True
+
+    def visit_With(self, node):
+        for item in node.items:
+            self.t(item.context_expr)
+            if item.optional_vars is not None:
+                self._assign(item.optional_vars, False)
+        for stmt in node.body:
+            self.visit(stmt)
+
+    def _comp(self, node):
+        for gen in node.generators:
+            self._assign(gen.target, self.t(gen.iter))
+            for cond in gen.ifs:
+                self.t(cond)
+
+    def _t_ListComp(self, node):
+        self._comp(node)
+        return self.t(node.elt)
+
+    def _t_SetComp(self, node):
+        self._comp(node)
+        return self.t(node.elt)
+
+    def _t_GeneratorExp(self, node):
+        self._comp(node)
+        return self.t(node.elt)
+
+    def _t_DictComp(self, node):
+        self._comp(node)
+        return self.t(node.key) or self.t(node.value)
+
+    def visit_Expr(self, node):
+        self.t(node.value)
+
+    def visit_FunctionDef(self, node):
+        pass  # nested scopes are their own FuncNodes
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.t(child)
+            else:
+                self.visit(child)
+
+    def run(self) -> "_Scan":
+        node = self.fn.node
+        if isinstance(node, ast.Lambda):
+            self.returns_device = self.t(node.body)
+            return self
+        for stmt in node.body:
+            self.visit(stmt)
+        return self
+
+    def _report(self, rule: str, node: ast.AST, msg: str) -> None:
+        if not (self.emit and self.fn.hot and not self.fn.jitted):
+            return
+        self.findings.append(Finding(
+            rule, self.fn.module.path, getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0),
+            f"{msg} [hot path: {self.fn.qualname}]",
+        ))
+
+
+class _Checker:
+    def __init__(self, project):
+        self.graph = callgraph.build(project)
+        self.facts: dict[callgraph.FuncNode, _FnFacts] = {
+            fn: _FnFacts() for fn in self.graph.funcs
+        }
+
+    def run(self) -> list[Finding]:
+        # fixpoint: which functions return device values
+        for _ in range(8):
+            changed = False
+            for fn in self.graph.funcs:
+                rd = _Scan(self, fn, emit=False).run().returns_device
+                if rd and not self.facts[fn].returns_device:
+                    self.facts[fn].returns_device = True
+                    changed = True
+            if not changed:
+                break
+        findings: list[Finding] = []
+        for fn in self.graph.hot_functions():
+            findings.extend(_Scan(self, fn, emit=True).run().findings)
+        return findings
+
+
+def check(project) -> list[Finding]:
+    return _Checker(project).run()
+
+
+register_project_checker("GL5", check)

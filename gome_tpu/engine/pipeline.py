@@ -47,7 +47,7 @@ class FramePipeline:
         self.depth = depth
         self._q: deque = deque()  # (pending, consumed, token)
 
-    def feed(self, cols: dict, token=None) -> list[tuple]:
+    def feed(self, cols: dict, token=None) -> list[tuple]:  # gomelint: hotpath
         eng = self.engine.batch
         fcols, consumed = self.engine.admit_frame(cols)
         try:
@@ -69,6 +69,7 @@ class FramePipeline:
             out.append(self._resolve_oldest())
         return out
 
+    # gomelint: hotpath
     def step(self):
         """Resolve the oldest in-flight frame, or None if nothing is in
         flight — the consumer's make-progress primitive when the order

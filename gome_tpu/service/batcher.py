@@ -145,7 +145,7 @@ class FrameBatcher:
                 buffered=len(self._buf),
             )
 
-    def submit(self, order: Order) -> None:
+    def submit(self, order: Order) -> None:  # gomelint: hotpath
         """Buffer one accepted order; flush if the size bound tripped.
 
         The encode+publish happens UNDER the lock: a swapped-out batch
@@ -246,7 +246,7 @@ class FrameBatcher:
         self._oldest = None
         return batch
 
-    def _deadline_loop(self) -> None:
+    def _deadline_loop(self) -> None:  # gomelint: hotpath
         while True:
             with self._lock:
                 spilled = bool(self._spill)

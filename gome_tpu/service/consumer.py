@@ -183,7 +183,7 @@ class OrderConsumer:
             # one write+fsync for the whole batch on the native backend
             self.bus.match_queue.publish_batch(batch.to_json_lines())
 
-    def run_once(self) -> int:
+    def run_once(self) -> int:  # gomelint: hotpath
         """Drain one micro-batch; returns the number of orders processed."""
         if self.pipeline_depth > 0:
             return self._run_once_pipelined()
@@ -394,6 +394,7 @@ class OrderConsumer:
         )
         self._thread.start()
 
+    # gomelint: hotpath
     def _loop(self) -> None:
         # Consecutive failures back off (decorrelated jitter) instead of
         # busy-spinning against a dead dependency; any success resets.

@@ -2,17 +2,25 @@
 """gomelint — run the domain-specific static analyzers over the tree.
 
     python scripts/gomelint.py gome_tpu                 # AST rules
-    python scripts/gomelint.py gome_tpu --jaxpr         # + jaxpr envelope
-    python scripts/gomelint.py gome_tpu --select GL4    # one family
+    python scripts/gomelint.py gome_tpu --jaxpr         # + jaxpr audits
+    python scripts/gomelint.py gome_tpu --select GL5    # one family
+    python scripts/gomelint.py gome_tpu --format sarif  # review annotations
+    python scripts/gomelint.py gome_tpu --update-baseline
     python scripts/gomelint.py --list-rules
 
-Exit status: 0 when clean, 1 when any finding survives suppressions,
-2 on usage errors. `--report FILE` writes the findings as JSON (the CI
-analysis job uploads it as an artifact). The AST rules are dependency-
-free; `--jaxpr` imports jax and traces the engine's device entry points
-(a few seconds on CPU), auditing every intermediate value's dtype against
-the declared book envelope — see gome_tpu/analysis/envelope.py and the
-"Static analysis" section of ARCHITECTURE.md.
+Exit status: 0 when every finding is clean or baselined, 1 when any NEW
+(non-baselined) finding survives suppressions, 2 on usage errors. The
+baseline (gome_tpu/analysis/baseline.json, override with --baseline,
+disable with --no-baseline) is the ratchet: existing debt is recorded by
+content-addressed fingerprint, new debt fails. `--report FILE` writes
+findings JSON and `--sarif FILE` writes SARIF 2.1.0 (both uploaded by the
+CI analysis job; SARIF renders as code-review annotations).
+
+The AST rules are dependency-free; `--jaxpr` imports jax and traces the
+engine's device entry points ONCE (a few seconds on CPU), feeding both
+the GL2xx dtype-envelope audit and the GL6xx buffer-donation audit from
+the same traced jaxprs — see gome_tpu/analysis/envelope.py,
+gome_tpu/analysis/donation.py, and ARCHITECTURE.md "Static analysis".
 """
 
 from __future__ import annotations
@@ -22,10 +30,21 @@ import json
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
 
 from gome_tpu.analysis import rule_catalogue, run_paths  # noqa: E402
-from gome_tpu.analysis.core import _ensure_checkers_loaded  # noqa: E402
+from gome_tpu.analysis.baseline import (  # noqa: E402
+    DEFAULT_BASELINE,
+    fingerprint_findings,
+    load_baseline,
+    partition,
+    save_baseline,
+)
+from gome_tpu.analysis.core import (  # noqa: E402
+    TOOL_VERSION,
+    _ensure_checkers_loaded,
+)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -34,15 +53,29 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--select", default="",
                     help="comma-separated rule ids/prefixes (GL1,GL402,...)")
     ap.add_argument("--jaxpr", action="store_true",
-                    help="also run the jaxpr int32-envelope audit (GL2xx)")
+                    help="also run the traced-engine audits: GL2xx "
+                         "dtype envelope + GL6xx buffer donation")
     ap.add_argument("--dtype", default="int32", choices=("int32", "int64"),
-                    help="declared book dtype for the envelope audit")
-    ap.add_argument("--format", default="text", choices=("text", "json"))
+                    help="declared book dtype for the jaxpr audits")
+    ap.add_argument("--format", default="text",
+                    choices=("text", "json", "sarif"))
     ap.add_argument("--report", default="",
                     help="write findings as JSON to this path")
+    ap.add_argument("--sarif", default="",
+                    help="write findings as SARIF 2.1.0 to this path")
+    ap.add_argument("--baseline", default=os.path.join(ROOT, DEFAULT_BASELINE),
+                    help="baseline file for the ratchet (default: "
+                         f"{DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every finding fails")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings "
+                         "and exit 0 (review the diff!)")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="include findings silenced by gomelint directives")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--version", action="version",
+                    version=f"gomelint {TOOL_VERSION}")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -57,22 +90,62 @@ def main(argv: list[str] | None = None) -> int:
     select = {s.strip().upper() for s in args.select.split(",") if s.strip()}
     findings = run_paths(args.paths, select or None,
                          keep_suppressed=args.show_suppressed)
-    if args.jaxpr and (not select or any(s.startswith("GL2") for s in select)):
-        from gome_tpu.analysis.envelope import check_engine_envelope
-        findings.extend(check_engine_envelope(args.dtype))
+    if args.jaxpr:
+        # One shared trace (envelope.traced_entries memo) feeds both
+        # jaxpr-driven families — GL2 and GL6 cost one engine trace total.
+        from gome_tpu.analysis.core import apply_file_suppressions
+        traced: list = []
+        if not select or any(s.startswith("GL2") for s in select):
+            from gome_tpu.analysis.envelope import check_engine_envelope
+            traced.extend(check_engine_envelope(args.dtype))
+        if not select or any(s.startswith("GL6") for s in select):
+            from gome_tpu.analysis.donation import check_engine_donation
+            traced.extend(check_engine_donation(args.dtype))
+        if not args.show_suppressed:
+            traced = apply_file_suppressions(traced, root=ROOT)
+        findings.extend(traced)
 
-    payload = [f.__dict__ for f in findings]
+    fingerprinted = fingerprint_findings(findings, root=ROOT)
+    if args.update_baseline:
+        save_baseline(args.baseline, fingerprinted)
+        print(f"gomelint: baseline updated with {len(findings)} "
+              f"finding(s) -> {args.baseline}")
+        return 0
+    base = {} if args.no_baseline else load_baseline(args.baseline)
+    new, known = partition(fingerprinted, base)
+
+    payload = [
+        dict(f.__dict__, fingerprint=fp, baselined=fp in base)
+        for f, fp in fingerprinted
+    ]
     if args.report:
         with open(args.report, "w", encoding="utf-8") as fh:
-            json.dump({"findings": payload, "count": len(findings)}, fh,
-                      indent=2)
+            json.dump(
+                {"findings": payload, "count": len(findings),
+                 "new": len(new), "baselined": len(known)},
+                fh, indent=2,
+            )
+    sarif_doc = None
+    if args.sarif or args.format == "sarif":
+        from gome_tpu.analysis.sarif import to_sarif
+        sarif_doc = to_sarif(fingerprinted, baselined=set(base), root=ROOT)
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(sarif_doc, fh, indent=2)
+
     if args.format == "json":
         print(json.dumps(payload, indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(sarif_doc, indent=2))
     else:
-        for f in findings:
-            print(f.format())
-        print(f"gomelint: {len(findings)} finding(s)")
-    return 1 if findings else 0
+        for f, fp in fingerprinted:
+            tag = " [baselined]" if fp in base else ""
+            print(f.format() + tag)
+        summary = f"gomelint: {len(findings)} finding(s)"
+        if known:
+            summary += f" ({len(known)} baselined, {len(new)} new)"
+        print(summary)
+    return 1 if new else 0
 
 
 if __name__ == "__main__":

@@ -448,5 +448,519 @@ def test_cli_exits_zero_on_tree_and_lists_rules():
 def test_rule_catalogue_covers_all_families():
     from gome_tpu.analysis import envelope  # noqa: F401 — registers GL2xx
     cat = rule_catalogue()
-    for family in ("GL1", "GL2", "GL3", "GL4"):
+    for family in ("GL1", "GL2", "GL3", "GL4", "GL5", "GL6"):
         assert any(r.startswith(family) for r in cat), family
+
+
+# --- GL5xx transfer-hygiene (hot-path engine) ----------------------------
+
+
+HOT_PREAMBLE = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def device_step(x):
+    return x * 2
+'''
+
+BAD_TRANSFERS = HOT_PREAMBLE + '''
+def hot(engine, orders):  # gomelint: hotpath
+    outs = device_step(orders)
+    total = outs[0].item()                      # GL501
+    host = np.asarray(outs)                     # GL502
+    if outs.sum() > 0:                          # GL503
+        total += 1
+    for i in range(4):
+        jax.block_until_ready(outs)             # GL504
+        up = jnp.asarray(np.zeros(8))           # GL505
+    return total, host, up
+'''
+
+
+def test_transfers_flags_every_rule():
+    findings = run_source(BAD_TRANSFERS)
+    assert rules_of(findings) == [
+        "GL501", "GL502", "GL503", "GL504", "GL505",
+    ]
+
+
+GOOD_TRANSFERS = HOT_PREAMBLE + '''
+def hot(engine, orders):  # gomelint: hotpath
+    grid = jnp.asarray(np.zeros(8))             # transfer OUTSIDE the loop
+    outs = device_step(grid)
+    host = np.asarray(jax.device_get(outs))     # the sanctioned fetch
+    jax.block_until_ready(outs)                 # drain once, not per-item
+    if host.sum() > 0:                          # host-side branch
+        return float(host[0])                   # host scalar: no sync
+    return 0.0
+'''
+
+
+def test_transfers_good_twin_is_clean():
+    assert run_source(GOOD_TRANSFERS) == []
+
+
+def test_transfers_silent_off_hot_path():
+    # identical body, no hotpath annotation: cold code may sync freely
+    cold = BAD_TRANSFERS.replace("  # gomelint: hotpath", "")
+    assert run_source(cold) == []
+
+
+def test_transfers_silent_inside_jit():
+    # inside traced code the same idioms are GL1xx's domain, not GL5xx's
+    src = HOT_PREAMBLE + '''
+def hot(x):  # gomelint: hotpath
+    return traced(x)
+
+@jax.jit
+def traced(x):
+    return x.item()
+'''
+    findings = run_source(src)
+    assert not any(f.rule.startswith("GL5") for f in findings)
+    assert any(f.rule == "GL102" for f in findings)  # GL1xx still covers it
+
+
+def test_transfers_suppression():
+    src = HOT_PREAMBLE + '''
+def hot(x):  # gomelint: hotpath
+    outs = device_step(x)
+    return outs.item()  # gomelint: disable=GL501 — single drain point
+'''
+    assert run_source(src) == []
+
+
+# --- hot-path reachability (analysis.callgraph) --------------------------
+
+
+def test_hotpath_seed_on_preceding_line():
+    src = HOT_PREAMBLE + '''
+# gomelint: hotpath
+def loop(x):
+    outs = device_step(x)
+    return float(outs)
+'''
+    assert rules_of(run_source(src)) == ["GL501"]
+
+
+def test_hotpath_propagates_through_calls():
+    src = HOT_PREAMBLE + '''
+def loop(x):  # gomelint: hotpath
+    return helper(x)
+
+def helper(x):
+    outs = device_step(x)
+    return outs.tolist()
+'''
+    findings = run_source(src)
+    assert rules_of(findings) == ["GL501"]
+    assert "helper" in findings[0].message
+
+
+def test_hotpath_callback_edge():
+    # a function REFERENCED (not called) from hot code is conservatively hot
+    src = HOT_PREAMBLE + '''
+import threading
+
+class Consumer:
+    def start(self):  # gomelint: hotpath
+        t = threading.Thread(target=self._loop)
+        t.start()
+
+    def _loop(self):
+        outs = device_step(1)
+        while outs.any():                        # GL503 via callback edge
+            pass
+'''
+    assert rules_of(run_source(src)) == ["GL503"]
+
+
+def test_hotpath_closure_edge():
+    src = HOT_PREAMBLE + '''
+def loop(x):  # gomelint: hotpath
+    def inner():
+        outs = device_step(x)
+        return int(outs)
+    return inner()
+'''
+    assert rules_of(run_source(src)) == ["GL501"]
+
+
+def test_hotpath_cross_module():
+    from gome_tpu.analysis import run_sources
+
+    mods = {
+        "svc/consumer.py": HOT_PREAMBLE + '''
+from engine import apply
+
+def run_once(x):  # gomelint: hotpath
+    return apply(x)
+''',
+        "engine/impl.py": HOT_PREAMBLE + '''
+def apply(x):
+    outs = device_step(x)
+    return float(outs)                           # GL501, hot via consumer
+''',
+    }
+    findings = run_sources(mods)
+    assert [f.rule for f in findings] == ["GL501"]
+    assert findings[0].path == "engine/impl.py"
+
+
+# --- GL6xx buffer-donation ------------------------------------------------
+
+
+def _avals(*specs):
+    return [tuple(s) for s in specs]
+
+
+def test_donation_gl601_fires_and_donating_twin_is_silent():
+    from gome_tpu.analysis.donation import audit_donation
+
+    out = _avals(((8, 128), "int32"), ((8,), "int32"))
+    args = [None, _avals(((8, 128), "int32"), ((8,), "int32"))]
+    bad = audit_donation("m.py:step", args, static_argnums=(0,),
+                         donate_argnums=(), out_avals=out)
+    assert [f.rule for f in bad] == ["GL601"]
+    good = audit_donation("m.py:step", args, static_argnums=(0,),
+                          donate_argnums=(1,), out_avals=out)
+    assert good == []
+
+
+def test_donation_gl601_ignores_immaterial_args():
+    from gome_tpu.analysis.donation import audit_donation
+
+    out = _avals(((1024, 64), "int32"), ((8,), "int32"))
+    args = [_avals(((8,), "int32"))]  # a lane-id sliver: matching but tiny
+    assert audit_donation("m.py:f", args, (), (), out) == []
+
+
+def test_donation_gl602_fires_on_useless_donation():
+    from gome_tpu.analysis.donation import audit_donation
+
+    out = _avals(((8, 128), "int32"))
+    bad = audit_donation(
+        "m.py:f", [_avals(((4, 4), "float32"))], static_argnums=(),
+        donate_argnums=(0,), out_avals=out,
+    )
+    assert [f.rule for f in bad] == ["GL602"]
+    good = audit_donation(
+        "m.py:f", [_avals(((8, 128), "int32"))], static_argnums=(),
+        donate_argnums=(0,), out_avals=out,
+    )
+    assert good == []
+
+
+DONATING_DEF = '''
+import functools, jax
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def stepd(state, ops):
+    return state + ops, ops
+'''
+
+
+def test_donation_gl603_fires_on_use_after_donation():
+    src = DONATING_DEF + '''
+def bad_caller(state, ops):
+    new, _ = stepd(state, ops)
+    return state.sum() + new          # state was donated: deleted
+'''
+    findings = run_source(src)
+    assert [f.rule for f in findings] == ["GL603"]
+
+
+def test_donation_gl603_rebind_and_return_are_clean():
+    src = DONATING_DEF + '''
+def rebinding(state, ops):
+    state, _ = stepd(state, ops)      # the rebind IS the death
+    return state
+
+def tail(state, ops):
+    if ops is None:
+        return stepd(state, ops)      # returns: nothing after reads state
+    return state.sum()
+'''
+    assert run_source(src) == []
+
+
+def test_engine_donation_audit_is_clean():
+    """The committed donation policy (twins donated, books retained with
+    justified suppressions) audits clean — the acceptance gate."""
+    from gome_tpu.analysis.core import apply_file_suppressions
+    from gome_tpu.analysis.donation import check_engine_donation
+
+    findings = apply_file_suppressions(check_engine_donation("int32"), ROOT)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# --- baseline fingerprints + ratchet -------------------------------------
+
+
+def test_fingerprint_survives_line_drift_and_file_moves(tmp_path):
+    from gome_tpu.analysis.baseline import fingerprint_findings
+    from gome_tpu.analysis.core import Finding
+
+    a = tmp_path / "a.py"
+    a.write_text("x = 1\nbad_line = sync()\n")
+    f1 = Finding("GL501", str(a), 2, 0, "sync on hot path [hot path: f]")
+    [(_, fp1)] = fingerprint_findings([f1])
+
+    # line drift: same content three lines lower
+    a.write_text("# pad\n# pad\nx = 1\nbad_line = sync()\n")
+    f2 = Finding("GL501", str(a), 4, 0, "sync on hot path [hot path: f]")
+    [(_, fp2)] = fingerprint_findings([f2])
+    assert fp1 == fp2
+
+    # file move: same content under a new path
+    b = tmp_path / "moved" ; b.mkdir()
+    bb = b / "renamed.py"
+    bb.write_text("bad_line = sync()\n")
+    f3 = Finding("GL501", str(bb), 1, 0, "sync on hot path [hot path: f]")
+    [(_, fp3)] = fingerprint_findings([f3])
+    assert fp1 == fp3
+
+    # changed code on the flagged line => new fingerprint
+    bb.write_text("bad_line = other_sync()\n")
+    [(_, fp4)] = fingerprint_findings([f3])
+    assert fp4 != fp1
+
+
+def test_fingerprint_disambiguates_identical_findings(tmp_path):
+    from gome_tpu.analysis.baseline import fingerprint_findings
+    from gome_tpu.analysis.core import Finding
+
+    a = tmp_path / "a.py"
+    a.write_text("v = s()\nv = s()\n")
+    fs = [Finding("GL501", str(a), 1, 0, "m"),
+          Finding("GL501", str(a), 2, 0, "m")]
+    fps = [fp for _, fp in fingerprint_findings(fs)]
+    assert len(set(fps)) == 2
+
+
+def test_baseline_roundtrip_and_partition(tmp_path):
+    from gome_tpu.analysis.baseline import (
+        fingerprint_findings, load_baseline, partition, save_baseline,
+    )
+    from gome_tpu.analysis.core import Finding
+
+    a = tmp_path / "a.py"
+    a.write_text("old = sync()\n")
+    old = Finding("GL501", str(a), 1, 0, "old debt")
+    fps = fingerprint_findings([old])
+    path = tmp_path / "baseline.json"
+    save_baseline(str(path), fps)
+    base = load_baseline(str(path))
+    assert len(base) == 1
+
+    a.write_text("old = sync()\nnew = sync2()\n")
+    new = Finding("GL502", str(a), 2, 0, "new debt")
+    both = fingerprint_findings([old, new])
+    fresh, known = partition(both, base)
+    assert [f.rule for f, _ in known] == ["GL501"]
+    assert [f.rule for f, _ in fresh] == ["GL502"]
+
+
+# --- SARIF 2.1.0 ----------------------------------------------------------
+
+
+def test_sarif_output_validates():
+    from gome_tpu.analysis.baseline import fingerprint_findings
+    from gome_tpu.analysis.core import Finding
+    from gome_tpu.analysis.sarif import to_sarif, validate_sarif
+
+    fs = [
+        Finding("GL501", "gome_tpu/x.py", 10, 4, "a sync"),
+        Finding("GL601", "gome_tpu/y.py", 1, 0, "a double-buffer"),
+    ]
+    fps = fingerprint_findings(fs)
+    doc = to_sarif(fps, baselined={fps[1][1]})
+    assert validate_sarif(doc) == []
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "gomelint"
+    res = run["results"]
+    assert res[0]["level"] == "error" and res[0]["baselineState"] == "new"
+    assert res[1]["level"] == "warning"
+    assert res[1]["suppressions"][0]["kind"] == "external"
+    assert res[0]["locations"][0]["physicalLocation"]["region"][
+        "startLine"] == 10
+    # the SARIF fingerprint IS the baseline fingerprint
+    assert res[0]["partialFingerprints"]["gomelint/v1"] == fps[0][1]
+
+
+def test_sarif_validator_rejects_malformed():
+    from gome_tpu.analysis.sarif import validate_sarif
+
+    assert validate_sarif({"version": "2.0.0", "runs": []})
+    bad_run = {
+        "version": "2.1.0",
+        "runs": [{"tool": {"driver": {"name": ""}},
+                  "results": [{"message": {}, "level": "fatal",
+                               "locations": [{"physicalLocation": {
+                                   "region": {"startLine": 0}}}]}]}],
+    }
+    errs = validate_sarif(bad_run)
+    assert any("level" in e for e in errs)
+    assert any("startLine" in e for e in errs)
+    assert any("message" in e for e in errs)
+
+
+def test_sarif_matches_jsonschema_expectations():
+    jsonschema = pytest.importorskip("jsonschema")
+    # a hand-reduced slice of the official 2.1.0 schema: the properties
+    # gomelint emits, with the spec's required/enum constraints
+    schema = {
+        "type": "object",
+        "required": ["version", "runs"],
+        "properties": {
+            "version": {"enum": ["2.1.0"]},
+            "runs": {"type": "array", "items": {
+                "type": "object", "required": ["tool"],
+                "properties": {
+                    "tool": {"type": "object", "required": ["driver"],
+                             "properties": {"driver": {
+                                 "type": "object", "required": ["name"]}}},
+                    "results": {"type": "array", "items": {
+                        "type": "object", "required": ["message"],
+                        "properties": {
+                            "level": {"enum": ["none", "note", "warning",
+                                               "error"]},
+                            "message": {"type": "object",
+                                        "required": ["text"]},
+                        }}},
+                }}},
+        },
+    }
+    from gome_tpu.analysis.baseline import fingerprint_findings
+    from gome_tpu.analysis.core import Finding
+    from gome_tpu.analysis.sarif import to_sarif
+
+    doc = to_sarif(fingerprint_findings(
+        [Finding("GL000", "x.py", 1, 0, "m")]))
+    jsonschema.validate(doc, schema)
+
+
+# --- whole-tree assertions for the new families ---------------------------
+
+
+def test_whole_tree_clean_for_transfer_and_donation_families():
+    """Satellite guarantee: the annotated hot paths (consumer, batcher,
+    engine driver, pipeline) carry no GL5xx host-sync and no GL603
+    use-after-donation today — regressions fail here with the exact
+    file:line."""
+    findings = [
+        f for f in run_paths([os.path.join(ROOT, "gome_tpu"),
+                              os.path.join(ROOT, "scripts"),
+                              os.path.join(ROOT, "bench.py")])
+        if f.rule.startswith(("GL5", "GL6"))
+    ]
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_hot_path_seeds_reach_the_engine():
+    """The hotpath annotations must actually cover the order path: if a
+    refactor renames a seed or breaks an edge, the GL5xx family would go
+    silently blind — this pins the reachability of the core driver."""
+    import glob
+
+    from gome_tpu.analysis import callgraph
+    from gome_tpu.analysis.core import Project, SourceModule
+
+    mods = []
+    for p in sorted(glob.glob(os.path.join(ROOT, "gome_tpu", "**", "*.py"),
+                              recursive=True)):
+        with open(p, encoding="utf-8") as fh:
+            mods.append(SourceModule(p, fh.read()))
+    graph = callgraph.build(Project(mods))
+    hot = {fn.name for fn in graph.hot_functions()}
+    for must in ("run_once", "_run_exact", "submit_frame", "resolve_frame",
+                 "_pack_grid_vectorized", "feed"):
+        assert must in hot, f"{must} fell off the hot path"
+
+
+# --- CLI v2: baseline ratchet, SARIF, --version ---------------------------
+
+
+def _cli(args, cwd=ROOT):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "gomelint.py"),
+         *args],
+        capture_output=True, text=True, env=env, cwd=cwd,
+    )
+
+
+def test_cli_version():
+    out = _cli(["--version"])
+    assert out.returncode == 0
+    assert "gomelint 2." in out.stdout
+
+
+def test_cli_baseline_ratchet_flow(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(HOT_PREAMBLE + '''
+def hot(x):  # gomelint: hotpath
+    outs = device_step(x)
+    return float(outs)
+''')
+    base = tmp_path / "baseline.json"
+
+    # 1. new finding, no baseline: fail
+    r = _cli([str(bad), "--baseline", str(base)])
+    assert r.returncode == 1 and "GL501" in r.stdout
+
+    # 2. accept the debt: --update-baseline exits 0 and writes the file
+    r = _cli([str(bad), "--baseline", str(base), "--update-baseline"])
+    assert r.returncode == 0 and base.exists()
+
+    # 3. ratchet: the same finding is baselined, exit 0
+    r = _cli([str(bad), "--baseline", str(base)])
+    assert r.returncode == 0 and "baselined" in r.stdout
+
+    # 4. line drift above the finding: fingerprint stable, still 0
+    bad.write_text("# moved\n# down\n" + bad.read_text())
+    r = _cli([str(bad), "--baseline", str(base)])
+    assert r.returncode == 0
+
+    # 5. NEW debt fails even with the old one baselined
+    bad.write_text(bad.read_text() + '''
+def hot2(x):  # gomelint: hotpath
+    outs = device_step(x)
+    return outs.item()
+''')
+    r = _cli([str(bad), "--baseline", str(base)])
+    assert r.returncode == 1 and "1 new" in r.stdout
+
+    # 6. --no-baseline: everything fails again
+    r = _cli([str(bad), "--no-baseline"])
+    assert r.returncode == 1
+
+
+def test_cli_sarif_format(tmp_path):
+    import json as _json
+
+    from gome_tpu.analysis.sarif import validate_sarif
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(HOT_PREAMBLE + '''
+def hot(x):  # gomelint: hotpath
+    return float(device_step(x))
+''')
+    sarif_path = tmp_path / "out.sarif"
+    r = _cli([str(bad), "--no-baseline", "--format", "sarif",
+              "--sarif", str(sarif_path)])
+    assert r.returncode == 1
+    doc = _json.loads(r.stdout)
+    assert validate_sarif(doc) == []
+    on_disk = _json.loads(sarif_path.read_text())
+    assert on_disk["runs"][0]["results"][0]["ruleId"] == "GL501"
+
+
+def test_committed_baseline_matches_tree():
+    """The acceptance command: the full run (AST families) against the
+    COMMITTED baseline exits 0 — new debt anywhere fails this test before
+    it fails CI."""
+    r = _cli(["gome_tpu", "scripts", "bench.py"])
+    assert r.returncode == 0, r.stdout + r.stderr
