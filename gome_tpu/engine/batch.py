@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -50,6 +51,16 @@ from .book import (
 )
 from .host import Interner, OpContext, decode_events, encode_op
 from .step import ACTION_ADD, _Side, step_rows_impl
+
+# The donating twins donate the whole ops pytree; XLA reuses most of its
+# buffers for the [S, T] outputs but (depending on layout/CSE) not all,
+# and warns "Some donated buffers were not usable" once per compiled
+# shape. That partial reuse is the intended trade (jax FAQ: filter the
+# warning when donation is deliberate); the unusable buffers are simply
+# freed.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
 
 
 def _book_to_rows(book: BookState):
@@ -90,8 +101,7 @@ def _lane_scan_impl(config: BookConfig, book: BookState, ops_lane: DeviceOp):
     return _rows_to_book(rows), outs
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def batch_step(
+def _batch_step_impl(
     config: BookConfig, books: BookState, ops: DeviceOp
 ) -> tuple[BookState, StepOutput]:
     """books: [S, ...] stacked BookState; ops: DeviceOp with [S, T] leaves.
@@ -112,11 +122,40 @@ def batch_step(
     return _writeback_full_cap(books, sub, cap), outs
 
 
-lane_scan = functools.partial(jax.jit, static_argnums=0)(_lane_scan_impl)
+# Two jit wrappers per entry, one trace cache each (both precompiled the
+# same way — shape combos are recorded per wrapper identity):
+#
+#   * the PUBLIC entry donates nothing: parity tests/benches replay the
+#     same books/ops through several kernels, and the books argument is
+#     retained by _run_exact for escalation replay and by _checkpoint for
+#     the transactional rollback (the "double-buffer" the GL6xx audit
+#     flags IS the transaction mechanism — see ARCHITECTURE.md);
+#   * the `_donating` twin donates the ops-grid transfer buffers. _step
+#     dispatches to it exactly when the grid is HOST-sourced (numpy —
+#     the object-path packers): every dispatch then re-transfers, so the
+#     device copy is provably dead and XLA reuses it for the [S, T]
+#     outputs instead of allocating fresh ones. Device-built scatter
+#     grids (frames.pack_frame_grids) stay undonated: the escalation
+#     path re-dispatches the same arrays.
+batch_step = functools.partial(  # gomelint: disable=GL601 — see note above
+    jax.jit, static_argnums=0
+)(_batch_step_impl)
+batch_step_donating = functools.partial(  # gomelint: disable=GL601 — see above
+    jax.jit, static_argnums=0, donate_argnums=(2,)
+)(_batch_step_impl)
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def dense_batch_step(
+lane_scan = functools.partial(  # gomelint: disable=GL601 — parity entry
+    jax.jit, static_argnums=0
+)(_lane_scan_impl)
+#: Escalation re-runs (_run_exact phase 2) build a fresh one-lane book
+#: slice and op row per call — both dead on return, so both donate.
+lane_scan_donating = functools.partial(
+    jax.jit, static_argnums=0, donate_argnums=(1, 2)
+)(_lane_scan_impl)
+
+
+def _dense_batch_step_impl(
     config: BookConfig, books: BookState, lane_ids, ops: DeviceOp
 ):
     """Gather→scan→scatter over a compact set of LIVE lanes.
@@ -153,8 +192,15 @@ def dense_batch_step(
     return new_books, outs
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4, 5))
-def dense_kernel_step(
+dense_batch_step = functools.partial(  # gomelint: disable=GL601 — see batch_step
+    jax.jit, static_argnums=0
+)(_dense_batch_step_impl)
+dense_batch_step_donating = functools.partial(  # gomelint: disable=GL601 — ibid.
+    jax.jit, static_argnums=0, donate_argnums=(3,)
+)(_dense_batch_step_impl)
+
+
+def _dense_kernel_step_impl(
     config: BookConfig,
     books: BookState,
     lane_ids,
@@ -189,8 +235,15 @@ def dense_kernel_step(
     return new_books, outs
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4))
-def full_kernel_step(
+dense_kernel_step = functools.partial(  # gomelint: disable=GL601 — see batch_step
+    jax.jit, static_argnums=(0, 4, 5)
+)(_dense_kernel_step_impl)
+dense_kernel_step_donating = functools.partial(  # gomelint: disable=GL601 — ibid.
+    jax.jit, static_argnums=(0, 4, 5), donate_argnums=(3,)
+)(_dense_kernel_step_impl)
+
+
+def _full_kernel_step_impl(
     config: BookConfig,
     books: BookState,
     ops: DeviceOp,
@@ -212,6 +265,14 @@ def full_kernel_step(
     if books.price.shape[-1] == cap:
         return sub, outs
     return _writeback_full_cap(books, sub, cap), outs
+
+
+full_kernel_step = functools.partial(  # gomelint: disable=GL601 — see batch_step
+    jax.jit, static_argnums=(0, 3, 4)
+)(_full_kernel_step_impl)
+full_kernel_step_donating = functools.partial(  # gomelint: disable=GL601 — ibid.
+    jax.jit, static_argnums=(0, 3, 4), donate_argnums=(2,)
+)(_full_kernel_step_impl)
 
 
 def _nop_grid(config: BookConfig, n_slots: int, t: int) -> dict[str, np.ndarray]:
@@ -975,6 +1036,7 @@ class BatchEngine:
             for ev in evs
         ]
 
+    # gomelint: hotpath
     def process_indexed(
         self, indexed: list[tuple[int, Order]]
     ) -> list[tuple[int, list[MatchResult]]]:
@@ -1051,7 +1113,7 @@ class BatchEngine:
                 self._ub_extra[lane] += 1  # count_ub upper-bound upkeep
         return DeviceOp(**grid), contexts, leftover
 
-    def process_columnar(self, orders: list[Order]):
+    def process_columnar(self, orders: list[Order]):  # gomelint: hotpath
         """Apply a micro-batch and return events as a columnar EventBatch
         (gome_tpu.engine.events) instead of MatchResult objects — the
         vectorized decode path that keeps the host in step with the device
@@ -1397,7 +1459,9 @@ class BatchEngine:
             lane = lane_of(row)
             lane_book = jax.tree.map(lambda a: a[lane], books_before)
             lane_ops = jax.tree.map(lambda a: a[row], ops)
-            _, lane_out = lane_scan(big, lane_book, lane_ops)
+            # Donating twin: the one-lane book slice and op row are built
+            # fresh above and dead after this call on both grid paths.
+            _, lane_out = lane_scan_donating(big, lane_book, lane_ops)
             self.stats.device_calls += 1
             lane_overrides[row] = jax.device_get(lane_out)
         return outs, lane_overrides
@@ -1418,6 +1482,17 @@ class BatchEngine:
         cfg = self.config
         if cap_g is not None and cap_g != cfg.cap:
             cfg = dataclasses.replace(cfg, cap=cap_g)
+        # Donation policy (GL6xx): a HOST-sourced grid (numpy — the
+        # object-path packers) re-transfers on every dispatch, so its
+        # device buffers are dead after the call and the donating twins
+        # let XLA reuse them for the outputs. Device-built grids
+        # (frames._scatter_grid_fn) must NOT donate: escalation replays
+        # re-dispatch the same arrays (_run_exact's phase-1 loop).
+        donate = isinstance(ops.action, np.ndarray)
+        _batch = batch_step_donating if donate else batch_step
+        _dense = dense_batch_step_donating if donate else dense_batch_step
+        _densek = dense_kernel_step_donating if donate else dense_kernel_step
+        _fullk = full_kernel_step_donating if donate else full_kernel_step
         if lane_ids is not None and self.mesh is not None:
             from ..parallel.mesh import shard_batch, sharded_dense_step
 
@@ -1461,11 +1536,11 @@ class BatchEngine:
                     pallas_available(cfg.dtype)
                     or self._pallas_interpret
                 ):
-                    return dense_kernel_step(
+                    return _densek(
                         cfg, books, ids, ops, block_s,
                         not pallas_available(cfg.dtype),
                     )
-            return dense_batch_step(cfg, books, ids, ops)
+            return _dense(cfg, books, ids, ops)
         if self.mesh is not None:
             from ..parallel.mesh import shard_batch, sharded_batch_step
 
@@ -1493,14 +1568,14 @@ class BatchEngine:
             if block_s is not None and (
                 pallas_available(cfg.dtype) or self._pallas_interpret
             ):
-                return full_kernel_step(
+                return _fullk(
                     cfg, books, ops, block_s,
                     not pallas_available(cfg.dtype),
                 )
             # int64 books, off-TPU, or lane counts the kernel cannot block:
             # the scan path has identical semantics at full speed (the
             # interpreter is a test vehicle, not a production fallback).
-        return batch_step(cfg, books, ops)
+        return _batch(cfg, books, ops)
 
     # -- snapshot support ----------------------------------------------------
     def export_state(self) -> dict:

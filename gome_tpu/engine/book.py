@@ -157,13 +157,17 @@ def ensure_dtype_usable(dtype) -> None:
 def init_book(config: BookConfig) -> BookState:
     ensure_dtype_usable(config.dtype)
     shape = (2, config.cap)
-    z = jnp.zeros(shape, config.dtype)
+    # One jnp.zeros call PER field: sharing a single zeros array across
+    # leaves would alias their device buffers, and a donated book (the
+    # single-op `step` entry donates its input, gomelint GL6xx) then trips
+    # XLA's "attempt to donate the same buffer twice".
+    z = lambda: jnp.zeros(shape, config.dtype)
     return BookState(
-        price=z,
-        lots=z,
+        price=z(),
+        lots=z(),
         seq=jnp.zeros(shape, config.seq_dtype),
-        oid=z,
-        uid=z,
+        oid=z(),
+        uid=z(),
         count=jnp.zeros((2,), jnp.int32),
         next_seq=jnp.zeros((), config.seq_dtype),
     )

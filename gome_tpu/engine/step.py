@@ -384,5 +384,13 @@ def step_impl(
 
 
 # Jitted entry point for single-op use (tests, debugging). Batched execution
-# nests step_impl under scan/vmap instead (gome_tpu.engine.batch).
-step = functools.partial(jax.jit, static_argnums=0)(step_impl)
+# nests step_impl under scan/vmap instead (gome_tpu.engine.batch). The book
+# is donated (gomelint GL601): callers thread it through (`book, out =
+# step(config, book, op)`), so the input book is dead on return — without
+# donation every single-op step double-buffers the book. The scalar op is
+# NOT donated: its leaves mostly cannot alias an output (XLA would warn
+# "donated buffers were not usable" on every compile) and the win is a few
+# bytes. Do NOT reuse a book object across step calls (gomelint GL603
+# flags it; donation-supporting backends raise "Array has been deleted").
+step = functools.partial(jax.jit, static_argnums=0,
+                         donate_argnums=(1,))(step_impl)

@@ -186,6 +186,12 @@ def test_int32_book_mode():
 
     with warnings.catch_warnings():
         warnings.simplefilter("error")  # unsafe-cast FutureWarning -> error
+        # the donating dispatch twins deliberately accept partial buffer
+        # reuse (engine/batch.py filters this globally; catch_warnings
+        # resets filters, so re-declare it inside the error scope)
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
         events = engine.process(orders)
     assert len(events) == 1 and events[0].match_volume == 3
     assert engine.books.price.dtype == jnp.int32
