@@ -34,7 +34,6 @@ no 64-bit lowering).
 
 from __future__ import annotations
 
-import functools
 import json
 import os
 import sys
@@ -162,6 +161,40 @@ def _next_pow2(n):
     while p < n:
         p *= 2
     return p
+
+
+def _analytic_block(dtype_name):
+    """Analytic XLA cost metrics (gome_tpu.obs.costmodel) folded into
+    every BENCH payload: the BENCH_*.json snapshots then carry flops/order,
+    bytes/order, arithmetic intensity, and peak HBM per hot-path entry —
+    plus the donation savings — next to wall-clock orders/sec, so the
+    analytic trajectory rides the same files as the measured one.
+    BENCH_ANALYTIC=0 skips (e.g. repeated sweeps); failures degrade to a
+    stderr note, never a broken bench."""
+    if os.environ.get("BENCH_ANALYTIC", "1") == "0":
+        return None
+    try:
+        from gome_tpu.obs import costmodel
+
+        return costmodel.bench_analytics(dtype_name)
+    except Exception as e:
+        print(f"# analytic cost model unavailable: {e}", file=sys.stderr)
+        return None
+
+
+def _jit_cache_sizes(**fns):
+    """{name: compiled-variant count} for the bench's own jits — the
+    payload's compile count (how many distinct shapes the timed chain
+    minted). Best-effort: the probe is a jax-internal accessor."""
+    out = {}
+    for name, fn in fns.items():
+        size = getattr(fn, "_cache_size", None)
+        if callable(size):
+            try:
+                out[name] = size()
+            except Exception:
+                pass
+    return out
 
 
 FIELDS = ("action", "side", "is_market", "price", "volume", "oid", "uid")
@@ -755,6 +788,13 @@ def service_main():
         "unit": "orders/sec",
         "vs_baseline": round(throughput / 1_000_000, 3),
     }
+    analytic = _analytic_block("int32")
+    if analytic is not None:
+        # The drill's own compile trajectory: how many distinct dispatch
+        # shape combos this flow minted (the perf ratchet gates the
+        # scripted-drill equivalent).
+        analytic["compiled_frame_combos"] = len(engine.batch._seen_combos)
+        result["analytic"] = analytic
     print(json.dumps(result))
     print(
         f"# mixed vs clean: on-link {mixed['throughput'] / 1e3:.0f}K vs "
@@ -1865,20 +1905,23 @@ def main():
                 file=sys.stderr,
             )
         throughput = timed_orders * chain_reps / elapsed
-        print(
-            json.dumps(
-                {
-                    "metric": (
-                        f"device matching throughput, config {CFG}, dense "
-                        f"rounds over live lanes (t_dense={t_dense}), "
-                        f"cap={CAP}, {DTYPE} ticks"
-                    ),
-                    "value": round(throughput),
-                    "unit": "orders/sec",
-                    "vs_baseline": round(throughput / 1_000_000, 3),
-                }
-            )
-        )
+        result = {
+            "metric": (
+                f"device matching throughput, config {CFG}, dense "
+                f"rounds over live lanes (t_dense={t_dense}), "
+                f"cap={CAP}, {DTYPE} ticks"
+            ),
+            "value": round(throughput),
+            "unit": "orders/sec",
+            "vs_baseline": round(throughput / 1_000_000, 3),
+        }
+        analytic = _analytic_block(DTYPE)
+        if analytic is not None:
+            analytic["compile_count"] = _jit_cache_sizes(
+                chain=timed_chain
+            ).get("chain")
+            result["analytic"] = analytic
+        print(json.dumps(result))
         if os.environ.get("BENCH_VERBOSE"):
             shapes = [
                 tuple(ops["action"].shape) for _, ops in timed_rounds
@@ -1963,6 +2006,12 @@ def main():
         "unit": "orders/sec",
         "vs_baseline": round(throughput / 1_000_000, 3),
     }
+    analytic = _analytic_block(DTYPE)
+    if analytic is not None:
+        analytic["compile_count"] = _jit_cache_sizes(
+            stepper=stepper
+        ).get("stepper")
+        result["analytic"] = analytic
     print(json.dumps(result))
     if os.environ.get("BENCH_VERBOSE"):
         print(

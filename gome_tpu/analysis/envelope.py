@@ -135,16 +135,22 @@ def check_jaxpr(closed, declared_dtype: str, context: str,
     return findings
 
 
-#: Per-dtype memo of the traced entry records: the GL2xx envelope walk
-#: and the GL6xx donation audit both consume these, and the host trace
-#: (~seconds on CPU) must be paid once per CLI/CI run, not per family.
+#: Per-dtype memo of the traced entry records: the GL2xx envelope walk,
+#: the GL6xx donation audit, AND the device cost model (gome_tpu.obs.
+#: costmodel) all consume these, and the host trace (~seconds on CPU)
+#: must be paid once per CLI/CI run, not per family.
 _TRACE_CACHE: dict[str, list[dict]] = {}
 
 
 def traced_entries(dtype: str = "int32") -> list[dict]:
     """Trace the engine's device entry points with small geometry ONCE
     per dtype; returns records ``{"context", "closed", "args"?,
-    "params"?, "wrappers"?}``. Imports jax lazily — the pure-AST checkers
+    "params"?, "jits"?, "n_ops"?}``. ``jits`` pairs each record with its
+    compiled public entry (and, where one exists, its ``_donating``
+    twin) as ``((label, jit_fn), ...)`` — the cost model lowers these
+    with the record's own ``args`` so attribution shares this memo's
+    canonical geometry; ``n_ops`` is the orders applied per call (the
+    per-order normalizer). Imports jax lazily — the pure-AST checkers
     must not pay for it.
 
     Tracing runs under the dtype's NATIVE x64 mode (int32 books deploy
@@ -173,7 +179,15 @@ def _entry_records_x64_scoped(dtype: str):
     import numpy as np
 
     from ..engine import frames as fr
-    from ..engine.batch import _lane_scan_impl, batch_step, dense_batch_step
+    from ..engine.batch import (
+        _lane_scan_impl,
+        batch_step,
+        batch_step_donating,
+        dense_batch_step,
+        dense_batch_step_donating,
+        lane_scan,
+        lane_scan_donating,
+    )
     from ..engine.book import BookConfig, DeviceOp, init_books
     from ..engine.step import step_impl
 
@@ -197,6 +211,7 @@ def _entry_records_x64_scoped(dtype: str):
             lambda b, o: step_impl(config, b, o))(one_book, one_op),
         args=(config, one_book, one_op),
         params=["config", "book", "op"],
+        n_ops=1,
     )
     yield dict(
         context="engine/batch.py:batch_step",
@@ -204,6 +219,11 @@ def _entry_records_x64_scoped(dtype: str):
             lambda b, o: batch_step(config, b, o))(books, op_grid),
         args=(config, books, op_grid),
         params=["config", "books", "ops"],
+        jits=(
+            ("batch_step", batch_step),
+            ("batch_step_donating", batch_step_donating),
+        ),
+        n_ops=s * t,
     )
     lane_ids = jnp.zeros((s,), jnp.int32)
     yield dict(
@@ -213,6 +233,11 @@ def _entry_records_x64_scoped(dtype: str):
         )(books, lane_ids, op_grid),
         args=(config, books, lane_ids, op_grid),
         params=["config", "books", "lane_ids", "ops"],
+        jits=(
+            ("dense_batch_step", dense_batch_step),
+            ("dense_batch_step_donating", dense_batch_step_donating),
+        ),
+        n_ops=s * t,
     )
     yield dict(
         context="engine/batch.py:lane_scan",
@@ -220,6 +245,11 @@ def _entry_records_x64_scoped(dtype: str):
             lambda b, o: _lane_scan_impl(config, b, o))(one_book, ops_lane),
         args=(config, one_book, ops_lane),
         params=["config", "book", "ops_lane"],
+        jits=(
+            ("lane_scan", lane_scan),
+            ("lane_scan_donating", lane_scan_donating),
+        ),
+        n_ops=t,
     )
 
     # frame compaction accumulator (the fast-path event path)
@@ -244,6 +274,10 @@ def _entry_records_x64_scoped(dtype: str):
             lambda o, f, c, tt: fr.compact_accum(config, o, f, c, tt,
                                                  np.int32(0))
         )(outs, fills_acc, cancels_acc, totals_acc),
+        args=(config, outs, fills_acc, cancels_acc, totals_acc,
+              np.int32(0)),
+        jits=(("compact_accum", fr.compact_accum),),
+        n_ops=s * t,
     )
 
     # device-side grid scatter-builder
@@ -253,6 +287,9 @@ def _entry_records_x64_scoped(dtype: str):
     yield dict(
         context="engine/frames.py:_scatter_grid_fn",
         closed=jax.make_jaxpr(scatter)(cols, flat),
+        args=(cols, flat),
+        jits=(("scatter_grid", scatter),),
+        n_ops=64,
     )
 
     # Pallas kernel, interpret mode (same jaxpr the TPU lowering consumes)

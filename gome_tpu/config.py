@@ -175,7 +175,12 @@ class OpsConfig:
     trace/trace_keep/slow_ms configure the order-lifecycle tracer
     (utils.trace): with trace on, every order gets a trace id at the
     gateway and the flight recorder keeps the last `trace_keep` complete
-    journeys plus every journey slower than `slow_ms` end to end."""
+    journeys plus every journey slower than `slow_ms` end to end.
+
+    cost/cost_keep configure the device cost surface (gome_tpu.obs): with
+    cost on, the compile journal is armed (gome_compile_seconds metrics +
+    the /cost endpoint's journal section) keeping the last `cost_keep`
+    compile events."""
 
     host: str = "127.0.0.1"
     port: int = 9109
@@ -183,6 +188,8 @@ class OpsConfig:
     trace: bool = True  # arm the order-lifecycle tracer with the endpoint
     trace_keep: int = 64  # flight-recorder ring size (journeys)
     slow_ms: float = 50.0  # slow-order threshold (pinned in the slow ring)
+    cost: bool = True  # arm the compile journal with the endpoint
+    cost_keep: int = 256  # compile-journal ring size (events)
 
     def __post_init__(self) -> None:
         if self.trace_keep <= 0:
@@ -192,6 +199,10 @@ class OpsConfig:
         if self.slow_ms < 0:
             raise ValueError(
                 f"ops.slow_ms must be >= 0, got {self.slow_ms}"
+            )
+        if self.cost_keep <= 0:
+            raise ValueError(
+                f"ops.cost_keep must be positive, got {self.cost_keep}"
             )
 
 

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.compile_journal import JOURNAL, frame_combo_detail
 from ..types import Action, OrderType
 from ..utils.trace import TRACER
 from .batch import BatchEngine, _next_pow2, _next_pow4, splice_outs
@@ -669,6 +670,7 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
             )
         for g_i, (ops, meta, lane_ids, cap_g) in enumerate(grids):
             t_disp = TRACER.clock() if TRACER.enabled else 0.0
+            t_disp_j = JOURNAL.clock() if JOURNAL.enabled else 0.0
             with TRACER.annotation("grid_dispatch"):
                 books, outs = eng._step(books, ops, lane_ids, cap_g)
                 eng.stats.device_calls += 1
@@ -706,6 +708,20 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
                     "compile_hit" if combo in eng._seen_combos
                     else "compile_miss",
                     t_disp, TRACER.clock(),
+                )
+            if JOURNAL.enabled and combo not in eng._seen_combos:
+                # Compile journal: the SAME miss path, but recording the
+                # combo itself (plus its analytic cost block) — the
+                # histogram can only say a compile happened, the journal
+                # says which shape and what it costs per dispatch. The
+                # detail block runs only here, where a full trace+compile
+                # was just paid.
+                JOURNAL.record(
+                    "frame_dispatch", combo,
+                    JOURNAL.clock() - t_disp_j,
+                    detail=frame_combo_detail(
+                        np.dtype(eng.config.dtype).name, combo
+                    ),
                 )
             eng._seen_combos.add(combo)
         eng.books = books

@@ -1,0 +1,54 @@
+"""Device-level observability (ISSUE 5).
+
+PR 2 gave the service spans, /metrics, and a flight recorder — all HOST
+clocks. Everything below the `device_execute` span was still a black box:
+what a compiled entry actually costs in FLOPs and HBM bytes, what a
+compile miss costs in wall-clock, what device memory the engine holds at
+steady state, and whether PR 4's buffer donation delivered the footprint
+win CPU timing could not see. This package closes that gap with four
+cooperating pieces:
+
+  * ``costmodel``  — per-entry FLOPs / bytes-accessed / HBM attribution
+    pulled from compiled executables' ``cost_analysis()`` /
+    ``memory_analysis()``, reusing the ``analysis.envelope.traced_entries``
+    memo's canonical geometry; includes the donation-effectiveness report
+    (public entry vs its ``_donating`` twin).
+  * ``compile_journal`` — a bounded journal of jit trace+compile events,
+    hooked on the ``_seen_combos`` miss path in ``engine.frames``;
+    exported as ``gome_compile_seconds{entry=...}`` metrics and the ops
+    ``/cost`` endpoint. Same hot-path contract as ``utils.trace``:
+    disabled (the default) it costs one attribute check and ZERO
+    allocations.
+  * ``live`` — tagged ``jax.live_arrays()`` snapshots (per-subsystem
+    HBM-residency gauges) and a steady-state leak detector.
+  * ``scripts/perf_ratchet.py`` — gates the deterministic analytic
+    metrics (flops/order, bytes/order, peak HBM, compile count) against
+    the committed ``PERF_BASELINE.json`` in CI.
+
+Import discipline: this ``__init__`` pulls in only ``compile_journal``
+(dependency-free) so ``engine.frames`` can import the JOURNAL singleton
+without a cycle; ``costmodel`` (which imports the engine) and ``live``
+load lazily on first attribute access.
+"""
+
+from __future__ import annotations
+
+from .compile_journal import JOURNAL, CompileJournal, frame_combo_detail
+
+__all__ = [
+    "JOURNAL",
+    "CompileJournal",
+    "frame_combo_detail",
+    "costmodel",
+    "live",
+]
+
+
+def __getattr__(name):
+    if name in ("costmodel", "live"):
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

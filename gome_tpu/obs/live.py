@@ -1,0 +1,166 @@
+"""Live-buffer accounting — tagged ``jax.live_arrays()`` snapshots and a
+steady-state leak detector.
+
+The engine's device residency story is an argument, not a measurement:
+books are "one stack", donation "reuses buffers", escalations "grow and
+replay". ``jax.live_arrays()`` enumerates every device buffer the process
+actually holds, so residency becomes data:
+
+  * :func:`live_array_stats` — process-wide count/bytes (after a gc pass:
+    dead-but-uncollected pytrees would otherwise read as residency);
+  * :func:`pytree_stats` — count/bytes of one subsystem's pytree (the
+    engine's book stack, a pending frame's compaction buffers, ...);
+  * :class:`LiveBufferMonitor` — named subsystems exported as
+    ``gome_hbm_resident_bytes{subsystem=...}`` callback gauges plus the
+    process totals (``gome_live_arrays`` / ``gome_live_array_bytes``) —
+    scrape-time reads, nothing on the hot path;
+  * :func:`leak_report` / :func:`assert_steady_state` — the leak
+    detector: at steady state an engine step must not grow the live
+    buffer count (escalations and first-seen compiles allocate, so the
+    caller settles those first). Asserted in tests/test_soak.py.
+"""
+
+from __future__ import annotations
+
+import gc
+
+
+def live_array_stats(collect: bool = True) -> dict:
+    """Process-wide live device-buffer count and bytes. ``collect`` runs
+    the gc first so reference cycles holding dead arrays (common in test
+    suites) do not read as device residency."""
+    import jax
+
+    if collect:
+        gc.collect()
+    arrs = jax.live_arrays()
+    total = 0
+    for a in arrs:
+        try:
+            total += int(a.nbytes)
+        except Exception:  # deleted between enumeration and read
+            pass
+    return {"count": len(arrs), "bytes": total}
+
+
+def pytree_stats(tree) -> dict:
+    """Count/bytes over one pytree's array leaves (host numpy leaves
+    count too — a restored-but-not-yet-placed subsystem is still
+    residency somewhere)."""
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    n = 0
+    total = 0
+    for leaf in leaves:
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            continue
+        n += 1
+        total += int(nbytes)
+    return {"count": n, "bytes": total}
+
+
+class LiveBufferMonitor:
+    """Named subsystems -> live-buffer gauges.
+
+    ``register(name, fn)`` stores a zero-arg callable returning the
+    subsystem's current pytree (called at snapshot/scrape time — the
+    engine swaps its book stack on every step, so the monitor must not
+    hold a reference). ``export(registry)`` wires scrape-time callback
+    gauges; ``snapshot()`` is the /cost JSON form."""
+
+    def __init__(self):
+        self._sections: dict[str, object] = {}
+
+    def register(self, name: str, fn) -> "LiveBufferMonitor":
+        self._sections[name] = fn
+        return self
+
+    def snapshot(self) -> dict:
+        out = {"total": live_array_stats()}
+        subsystems = {}
+        for name, fn in self._sections.items():
+            try:
+                subsystems[name] = pytree_stats(fn())
+            except Exception as exc:  # a dead subsystem must not 500 /cost
+                subsystems[name] = {"error": str(exc)}
+        out["subsystems"] = subsystems
+        return out
+
+    def export(self, registry=None) -> None:
+        """Register scrape-time gauges: per-subsystem
+        ``gome_hbm_resident_bytes{subsystem=...}`` plus process totals."""
+        from ..utils.metrics import REGISTRY
+
+        registry = registry or REGISTRY
+        registry.callback_gauge(
+            "gome_live_arrays",
+            "process-wide live device-buffer count (jax.live_arrays)",
+            lambda: live_array_stats(collect=False)["count"],
+        )
+        registry.callback_gauge(
+            "gome_live_array_bytes",
+            "process-wide live device-buffer bytes (jax.live_arrays)",
+            lambda: live_array_stats(collect=False)["bytes"],
+        )
+        for name, fn in self._sections.items():
+            registry.callback_gauge(
+                "gome_hbm_resident_bytes",
+                "per-subsystem device-resident bytes",
+                (lambda f: lambda: pytree_stats(f())["bytes"])(fn),
+                labels={"subsystem": name},
+            )
+
+
+def service_monitor(service) -> LiveBufferMonitor:
+    """The standard subsystem tagging for one EngineService/MatchEngine:
+    the device book stack (the dominant steady-state residency) — reads
+    go through the closure so engine growth/restore is always reflected."""
+    mon = LiveBufferMonitor()
+    engine = getattr(service, "engine", service)
+    batch = getattr(engine, "batch", engine)
+    mon.register("engine_books", lambda: batch.books)
+    return mon
+
+
+# -- leak detection --------------------------------------------------------
+
+
+def leak_report(step_fn, steps: int = 8, settle: int = 2) -> dict:
+    """Run ``step_fn`` ``settle`` times (escalations, first-seen compiles,
+    and cache warms allocate legitimately), snapshot the live-buffer
+    count, then run ``steps`` more and record the count after each. A
+    steady-state engine loop must come back to the baseline every step —
+    monotonic growth is a leaked device buffer (a retained checkpoint, an
+    accumulator that outlived its frame, a cache without a bound).
+
+    Returns {"baseline", "counts", "leaked"}: ``leaked`` = final count
+    minus baseline (<= 0 means flat)."""
+    for _ in range(settle):
+        step_fn()
+    baseline = live_array_stats()["count"]
+    counts = []
+    for _ in range(steps):
+        step_fn()
+        counts.append(live_array_stats()["count"])
+    return {
+        "baseline": baseline,
+        "counts": counts,
+        "leaked": (counts[-1] - baseline) if counts else 0,
+    }
+
+
+def assert_steady_state(
+    step_fn, steps: int = 8, settle: int = 2, tolerance: int = 0
+) -> dict:
+    """leak_report + assertion: raises AssertionError when the loop leaks
+    more than ``tolerance`` buffers end to end. Returns the report."""
+    report = leak_report(step_fn, steps=steps, settle=settle)
+    if report["leaked"] > tolerance:
+        raise AssertionError(
+            f"live device buffers grew by {report['leaked']} over "
+            f"{steps} steady-state steps (baseline {report['baseline']}, "
+            f"trajectory {report['counts']}) — leaked buffer(s)"
+        )
+    return report

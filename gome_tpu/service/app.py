@@ -119,6 +119,13 @@ class EngineService:
                         slow_threshold_s=self.config.ops.slow_ms / 1e3,
                     )
                 )
+            if self.config.ops.cost:
+                # Arm the compile journal (gome_tpu.obs): first-seen
+                # frame-dispatch combos land in gome_compile_seconds
+                # metrics and the ops /cost endpoint.
+                from ..obs.compile_journal import JOURNAL
+
+                JOURNAL.install(keep_n=self.config.ops.cost_keep)
             self.ops = OpsServer(
                 self, host=self.config.ops.host, port=self.config.ops.port
             )

@@ -1,7 +1,10 @@
 """Operator HTTP endpoint: /metrics (Prometheus text format from
-utils.metrics.REGISTRY), /healthz (service.health.HealthMonitor JSON), and
+utils.metrics.REGISTRY), /healthz (service.health.HealthMonitor JSON),
 /trace (the order-lifecycle flight recorder as Chrome trace-event JSON —
-load the dump in chrome://tracing or https://ui.perfetto.dev).
+load the dump in chrome://tracing or https://ui.perfetto.dev), and /cost
+(device-level attribution JSON: the compile journal, live-buffer
+residency, and the XLA cost model incl. the donation-effectiveness
+report — gome_tpu.obs).
 
 The reference has no observability surface at all (SURVEY §5.5 — logging
 only); this is the cheap operator-facing extension the TPU service ships:
@@ -10,6 +13,7 @@ one stdlib ThreadingHTTPServer, no dependencies, curl-able:
     curl localhost:9109/metrics
     curl localhost:9109/healthz     # 200 healthy / 503 unhealthy
     curl localhost:9109/trace > trace.json   # open in Perfetto
+    curl localhost:9109/cost        # compiles + HBM + per-entry cost
 
 Enabled by an `ops:` section in config.yaml (port, host) or by
 constructing OpsServer directly around any EngineService.
@@ -45,10 +49,52 @@ class OpsServer:
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self.monitor = None
+        self.live_monitor = None
         if service is not None:
             from .health import HealthMonitor
 
             self.monitor = HealthMonitor(service)
+            from ..obs.live import service_monitor
+
+            # Tagged live-buffer residency for /cost and the
+            # gome_hbm_resident_bytes{subsystem=...} gauges — all
+            # scrape-time reads, nothing on the hot path.
+            self.live_monitor = service_monitor(service)
+            self.live_monitor.export(self.registry)
+
+    def cost_payload(self) -> dict:
+        """The /cost JSON document: compile journal (gome_tpu.obs.
+        compile_journal.JOURNAL), live-buffer residency, and the
+        memoized XLA cost model + donation-effectiveness report. The
+        cost model compiles tiny canonical-geometry executables on first
+        read (memoized process-wide); a backend without cost_analysis
+        degrades to null fields rather than a 500."""
+        from ..obs.compile_journal import JOURNAL
+        from ..obs.live import LiveBufferMonitor
+
+        payload: dict = {"compile_journal": JOURNAL.as_dict()}
+        mon = self.live_monitor or LiveBufferMonitor()
+        payload["live_buffers"] = mon.snapshot()
+        try:
+            from ..obs import costmodel
+
+            dtype = "int32"
+            svc = self.service
+            if svc is not None:
+                import numpy as np
+
+                engine = getattr(svc, "engine", None)
+                if engine is not None:
+                    dtype = np.dtype(engine.config.dtype).name
+            payload["cost_model"] = {
+                "dtype": dtype,
+                "entries": costmodel.entry_report(dtype),
+                "donation": costmodel.donation_report(dtype),
+            }
+        except Exception as exc:  # never 500 the whole surface
+            log.exception("cost model unavailable")
+            payload["cost_model"] = {"error": str(exc)}
+        return payload
 
     def start(self) -> "OpsServer":
         ops = self
@@ -87,6 +133,11 @@ class OpsServer:
                             200 if health.healthy else 503, body,
                             "application/json",
                         )
+                    elif self.path.split("?")[0] == "/cost":
+                        body = json.dumps(
+                            ops.cost_payload(), default=str
+                        ).encode()
+                        self._send(200, body, "application/json")
                     elif self.path.split("?")[0] == "/trace":
                         rec = ops.tracer.recorder
                         dump = (
@@ -111,8 +162,8 @@ class OpsServer:
             target=self._httpd.serve_forever, name="ops-http", daemon=True
         )
         self._thread.start()
-        log.info("ops endpoint up on %s:%d (/metrics, /healthz)",
-                 self.host, self.port)
+        log.info("ops endpoint up on %s:%d (/metrics, /healthz, /trace, "
+                 "/cost)", self.host, self.port)
         return self
 
     def stop(self) -> None:

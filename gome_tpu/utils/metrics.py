@@ -70,13 +70,25 @@ class Registry:
         )
         return fam.child(labels)
 
-    def callback_gauge(self, name: str, help: str, fn) -> "CallbackGauge":
+    def callback_gauge(
+        self, name: str, help: str, fn, labels: dict | None = None
+    ) -> "CallbackGauge":
         """A gauge whose value is read from `fn()` at scrape time — for
         state that already lives somewhere (spill depth, breaker state)
         and would otherwise need push updates on every change. Re-
         registering the same name rebinds the callback (components are
-        rebuilt across service restarts in tests)."""
-        g = self._get(name, lambda: CallbackGauge(name, help, fn))
+        rebuilt across service restarts in tests). With `labels`, the
+        name is a family like the other metric kinds (one child per
+        label set, e.g. per-subsystem HBM residency gauges)."""
+        if labels is None:
+            g = self._get(name, lambda: CallbackGauge(name, help, fn))
+            g._fn = fn
+            return g
+        fam = self._family(
+            name, help, "gauge",
+            lambda lb: CallbackGauge(name, help, fn, labels=lb),
+        )
+        g = fam.child(labels)
         g._fn = fn
         return g
 
@@ -208,10 +220,10 @@ class CallbackGauge:
     failing callback scrapes as 0 rather than breaking the whole /metrics
     exposition."""
 
-    def __init__(self, name: str, help: str, fn):
+    def __init__(self, name: str, help: str, fn, labels: dict | None = None):
         self.name = name
         self.help = help
-        self.labels = None
+        self.labels = labels
         self._fn = fn
 
     def value(self):
@@ -221,7 +233,7 @@ class CallbackGauge:
             return 0.0
 
     def render_samples(self) -> list[str]:
-        return [f"{self.name} {self.value()}"]
+        return [f"{self.name}{_label_str(self.labels)} {self.value()}"]
 
     def render(self) -> str:
         return (

@@ -1,15 +1,20 @@
 """Observability snapshot: run a small traced order drill through the
-in-process service stack and dump the two operator surfaces to files —
+in-process service stack and dump the operator surfaces to files —
 
   <out_dir>/metrics.txt   the /metrics Prometheus exposition (per-stage
-                          gome_stage_seconds histograms included)
+                          gome_stage_seconds histograms + the
+                          gome_compile_seconds family included)
   <out_dir>/trace.json    one flight-recorder dump as Chrome trace-event
                           JSON (load in chrome://tracing or Perfetto)
+  <out_dir>/cost.json     the /cost payload: compile journal (fed by a
+                          frame drill through the fast path), live-buffer
+                          residency, and the XLA cost model incl. the
+                          donation-effectiveness report
 
     python scripts/obs_snapshot.py [out_dir=obs-artifacts]
 
-CI (tier1.yml) uploads both as build artifacts after the test run, so
-every push records what the pipeline's observability surfaces actually
+CI (tier1.yml) uploads all three as build artifacts after the test run,
+so every push records what the pipeline's observability surfaces actually
 look like — and a broken exposition/dump fails the step loudly.
 """
 
@@ -24,18 +29,42 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def _order_frame(n: int, symbols: list, seed: int):
+    """One deterministic ORDER-frame column dict (the fast-path shape)
+    so the compile journal sees real first-seen dispatch combos."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return dict(
+        n=n,
+        action=np.ones(n, np.int64),
+        side=rng.integers(0, 2, n).astype(np.int64),
+        kind=np.zeros(n, np.int64),
+        price=rng.integers(99_000, 101_000, n).astype(np.int64),
+        volume=rng.integers(1, 10, n).astype(np.int64),
+        symbols=symbols,
+        symbol_idx=rng.integers(0, len(symbols), n).astype(np.int64),
+        uuids=["u0"],
+        uuid_idx=np.zeros(n, np.int64),
+        oids=np.char.add("f", np.arange(n).astype("U6")).astype("S"),
+    )
+
+
 def main(out_dir: str = "obs-artifacts") -> int:
     from gome_tpu.api import order_pb2 as pb
     from gome_tpu.config import Config, EngineConfig, OpsConfig
+    from gome_tpu.obs.compile_journal import JOURNAL
     from gome_tpu.service.app import EngineService
+    from gome_tpu.service.ops import OpsServer
     from gome_tpu.utils.metrics import REGISTRY
     from gome_tpu.utils.trace import TRACER
 
     os.makedirs(out_dir, exist_ok=True)
     cfg = Config(
         engine=EngineConfig(cap=32, n_slots=16, max_t=8, dtype="int32"),
-        # ops.enabled arms the order-lifecycle tracer (app wiring); the
-        # HTTP server itself is not started — we snapshot in-process.
+        # ops.enabled arms the order-lifecycle tracer AND the compile
+        # journal (app wiring); the HTTP server itself is not started —
+        # we snapshot in-process.
         ops=OpsConfig(enabled=True, trace=True, trace_keep=32),
     )
     svc = EngineService(cfg)
@@ -59,9 +88,21 @@ def main(out_dir: str = "obs-artifacts") -> int:
         None,
     )
     svc.pump()
+    # One ORDER frame through the engine fast path (below admission —
+    # the drill's synthetic ADDs carry no pre-pool marks): the compile
+    # journal hooks the frame dispatch's _seen_combos miss path, so this
+    # is what puts real first-seen combos (and gome_compile_seconds
+    # samples) in the snapshot.
+    from gome_tpu.engine import frames
+
+    symbols = [f"sym{i}" for i in range(4)]
+    frames.apply_frame_fast(
+        svc.engine.batch, _order_frame(64, symbols, seed=5)
+    )
 
     metrics = REGISTRY.render()
     assert "gome_stage_seconds" in metrics, "stage histograms missing"
+    assert "gome_compile_seconds" in metrics, "compile histograms missing"
     with open(os.path.join(out_dir, "metrics.txt"), "w") as f:
         f.write(metrics)
 
@@ -70,16 +111,29 @@ def main(out_dir: str = "obs-artifacts") -> int:
     with open(os.path.join(out_dir, "trace.json"), "w") as f:
         json.dump(dump, f, indent=1)
 
+    # The /cost payload via the SAME code path the HTTP endpoint serves
+    # (OpsServer.cost_payload), without binding a socket.
+    cost = OpsServer(svc).cost_payload()
+    assert cost["compile_journal"]["entries"], "compile journal is empty"
+    assert cost["cost_model"].get("entries"), "cost model empty"
+    assert cost["live_buffers"]["total"]["count"] > 0, "no live buffers?"
+    with open(os.path.join(out_dir, "cost.json"), "w") as f:
+        json.dump(cost, f, indent=1, default=str)
+
     journeys = {
         ev["args"]["trace_id"]
         for ev in dump["traceEvents"]
         if ev.get("ph") == "X"
     }
+    n_compiles = len(cost["compile_journal"]["entries"])
     print(
-        f"wrote {out_dir}/metrics.txt ({len(metrics)} bytes) and "
+        f"wrote {out_dir}/metrics.txt ({len(metrics)} bytes), "
         f"{out_dir}/trace.json ({len(dump['traceEvents'])} events, "
-        f"{len(journeys)} journeys)"
+        f"{len(journeys)} journeys), and {out_dir}/cost.json "
+        f"({n_compiles} journaled compiles, "
+        f"{len(cost['cost_model']['entries'])} cost-model entries)"
     )
+    JOURNAL.disable()
     return 0
 
 

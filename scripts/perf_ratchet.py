@@ -1,0 +1,267 @@
+"""Perf-regression ratchet over DETERMINISTIC analytic device metrics.
+
+Wall-clock throughput cannot gate CI (shared runners, no TPU), but the
+engine's ANALYTIC cost surface can: XLA's per-executable flops / bytes
+accessed / peak-HBM attribution (gome_tpu.obs.costmodel, at the canonical
+envelope geometry) and the compile count of a scripted frame drill are
+exact functions of the code — on the CPU backend they are bit-identical
+run to run. This script gates them against the committed
+``PERF_BASELINE.json`` exactly like gomelint's findings ratchet: a
+regression (any gated metric grows past its tolerance) fails CI; an
+improvement passes and prints a nudge to re-baseline; ``--update-baseline``
+rewrites the file to the current values and the diff is reviewed like any
+other code change.
+
+    python scripts/perf_ratchet.py                    # gate (CI)
+    python scripts/perf_ratchet.py --update-baseline  # re-baseline
+    python scripts/perf_ratchet.py --report out.json  # machine-readable
+
+Gated metrics (lower is better for all):
+  * ``<entry>.flops_per_order`` / ``<entry>.bytes_per_order`` /
+    ``<entry>.peak_hbm_bytes`` per hot-path entry (batch_step,
+    dense_batch_step, lane_scan, compact_accum, scatter_grid);
+  * ``frame_drill.compile_count`` — distinct dispatch shape combos a
+    fixed scripted frame flow mints (the _seen_combos cardinality): a
+    shape-oscillation regression (the class of bug the grow-only
+    geometry ratchets exist to prevent) shows up here as an extra
+    compile, gated at tolerance 0.
+
+Advisory (recorded in the report, NEVER gated): the drill's wall-clock
+orders/sec — the trend line humans read next to the gated metrics.
+
+Toolchain drift: the XLA numbers are deterministic per jaxlib VERSION,
+not across versions. The baseline records the jax version it was taken
+with; on a mismatch the XLA metrics degrade to a loud warning (advisory)
+while the version-independent compile count stays gated — bumping jax
+then requires an explicit ``--update-baseline`` commit.
+
+Exit codes: 0 ok / baseline updated; 1 regression or missing baseline;
+2 internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DEFAULT_BASELINE = os.path.join(ROOT, "PERF_BASELINE.json")
+
+#: Relative headroom per gated metric before a growth counts as a
+#: regression. Compile count is exact by construction: one extra
+#: compiled shape IS the regression.
+DEFAULT_TOLERANCE = 0.02
+EXACT_METRICS = ("frame_drill.compile_count",)
+
+
+def _drill_frame(n: int, n_symbols: int, seed: int, oid0: int) -> dict:
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    action = np.ones(n, np.int64)
+    # deterministic cancel positions targeting earlier oids
+    dels = rng.random(n) < 0.2
+    action[dels] = 2
+    return dict(
+        n=n,
+        action=action,
+        side=rng.integers(0, 2, n).astype(np.int64),
+        kind=np.zeros(n, np.int64),
+        price=rng.integers(99_000, 101_000, n).astype(np.int64),
+        volume=rng.integers(1, 50, n).astype(np.int64),
+        symbols=[f"s{i}" for i in range(n_symbols)],
+        symbol_idx=rng.integers(0, n_symbols, n).astype(np.int64),
+        uuids=["u0", "u1"],
+        uuid_idx=rng.integers(0, 2, n).astype(np.int64),
+        oids=np.char.add(
+            "o", np.arange(oid0, oid0 + n).astype("U8")
+        ).astype("S"),
+    )
+
+
+def frame_drill() -> dict:
+    """Scripted fast-path frame flow: fixed seeds, fixed sizes, fixed
+    engine geometry — every dispatch shape combo it mints is a pure
+    function of the packing/geometry code. Returns the gated compile
+    count plus advisory wall-clock."""
+    import jax.numpy as jnp
+
+    from gome_tpu.engine import frames
+    from gome_tpu.engine.batch import BatchEngine
+    from gome_tpu.engine.book import BookConfig
+
+    eng = BatchEngine(
+        BookConfig(cap=64, max_fills=4, dtype=jnp.int32),
+        n_slots=16, max_t=8,
+    )
+    n_orders = 0
+    t0 = time.perf_counter()
+    for i, n in enumerate((64, 64, 128, 64, 256, 128)):
+        frames.apply_frame_fast(
+            eng, _drill_frame(n, n_symbols=8, seed=100 + i, oid0=n_orders)
+        )
+        n_orders += n
+    elapsed = time.perf_counter() - t0
+    return {
+        "gated": {
+            "frame_drill.compile_count": len(eng._seen_combos),
+        },
+        "advisory": {
+            "frame_drill.orders": n_orders,
+            "frame_drill.wall_seconds": round(elapsed, 3),
+            "frame_drill.orders_per_sec": round(n_orders / elapsed),
+            "frame_drill.device_calls": eng.stats.device_calls,
+            "frame_drill.frame_fallbacks": eng.stats.frame_fallbacks,
+        },
+    }
+
+
+def collect() -> dict:
+    """{"jax": version, "gated": {...}, "advisory": {...}}."""
+    import jax
+
+    from gome_tpu.obs import costmodel
+
+    gated = dict(costmodel.ratchet_metrics("int32"))
+    drill = frame_drill()
+    gated.update(drill["gated"])
+    return {
+        "jax": jax.__version__,
+        "gated": gated,
+        "advisory": drill["advisory"],
+    }
+
+
+def gate(current: dict, baseline: dict) -> tuple[list[str], list[str]]:
+    """(regressions, notes) against a loaded baseline document."""
+    regressions: list[str] = []
+    notes: list[str] = []
+    base_metrics = baseline.get("metrics", {})
+    tolerances = baseline.get("tolerance", {})
+    version_match = baseline.get("jax") == current["jax"]
+    if not version_match:
+        notes.append(
+            f"jax {current['jax']} != baseline jax {baseline.get('jax')}: "
+            "XLA-derived metrics degraded to ADVISORY (re-baseline with "
+            "--update-baseline after reviewing the new numbers); the "
+            "compile count stays gated."
+        )
+    for name, cur in sorted(current["gated"].items()):
+        base = base_metrics.get(name)
+        if base is None:
+            notes.append(
+                f"new metric {name}={cur} not in baseline "
+                "(run --update-baseline to start gating it)"
+            )
+            continue
+        exact = name in EXACT_METRICS
+        if not exact and not version_match:
+            continue  # XLA numbers are per-jaxlib; advisory on mismatch
+        tol = 0.0 if exact else float(
+            tolerances.get(name, tolerances.get("default",
+                                               DEFAULT_TOLERANCE))
+        )
+        limit = base * (1.0 + tol)
+        if cur > limit + 1e-9:
+            regressions.append(
+                f"{name}: {cur} > baseline {base} (+{tol:.0%} tolerance)"
+            )
+        elif cur < base * (1.0 - max(tol, 0.0)) - 1e-9:
+            notes.append(
+                f"{name} improved: {cur} < baseline {base} — consider "
+                "--update-baseline to lock in the win"
+            )
+    for name in sorted(set(base_metrics) - set(current["gated"])):
+        # A metric the baseline gates but the current run cannot produce
+        # (backend stopped reporting it) must not pass silently.
+        regressions.append(
+            f"{name}: in baseline but absent from the current run"
+        )
+    return regressions, notes
+
+
+def save_baseline(path: str, current: dict) -> None:
+    doc = {
+        "version": 1,
+        "tool": "perf_ratchet",
+        "jax": current["jax"],
+        "note": (
+            "Deterministic analytic device metrics (lower is better). CI "
+            "fails when a gated metric grows past its tolerance. "
+            "Regenerate with scripts/perf_ratchet.py --update-baseline; "
+            "review the diff — shrinking is progress, growing is debt."
+        ),
+        "tolerance": {"default": DEFAULT_TOLERANCE},
+        "metrics": dict(sorted(current["gated"].items())),
+        "advisory": dict(sorted(current["advisory"].items())),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="perf_ratchet", description=__doc__)
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: PERF_BASELINE.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current metrics")
+    ap.add_argument("--report", default="",
+                    help="also write the full report JSON here")
+    args = ap.parse_args(argv)
+
+    try:
+        current = collect()
+    except Exception as exc:  # an unusable toolchain is an ERROR, not a pass
+        print(f"perf_ratchet: metric collection failed: {exc}",
+              file=sys.stderr)
+        return 2
+
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            json.dump(current, fh, indent=2)
+            fh.write("\n")
+
+    if args.update_baseline:
+        save_baseline(args.baseline, current)
+        print(f"perf_ratchet: baseline rewritten "
+              f"({len(current['gated'])} gated metrics) -> {args.baseline}")
+        return 0
+
+    try:
+        with open(args.baseline, encoding="utf-8") as fh:
+            baseline = json.load(fh)
+    except OSError:
+        print(
+            f"perf_ratchet: no baseline at {args.baseline} — run with "
+            "--update-baseline and commit the file",
+            file=sys.stderr,
+        )
+        return 1
+
+    regressions, notes = gate(current, baseline)
+    for n in notes:
+        print(f"# {n}")
+    for a, v in sorted(current["advisory"].items()):
+        print(f"# advisory {a} = {v}")
+    if regressions:
+        print(f"perf_ratchet: {len(regressions)} regressed metric(s):")
+        for r in regressions:
+            print(f"  REGRESSION {r}")
+        return 1
+    print(
+        f"perf_ratchet: OK — {len(current['gated'])} gated metrics within "
+        "baseline"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

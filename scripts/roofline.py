@@ -1,18 +1,28 @@
 """Device-kernel roofline probe (ARCHITECTURE.md's roofline section).
 
-Times the compiled Pallas match kernel at the headline shape while
-sweeping the knobs that distinguish the candidate ceilings:
+Two parts:
 
-  * cap sweep    — per-step work is O(cap) vector ops over [block_s, cap]
-                   tiles; if throughput scales ~1/cap the kernel is
-                   compute/dependency-bound, not launch-bound;
-  * block_t sweep — deeper time blocks amortize grid/launch overhead; a
-                   plateau means launches are not the ceiling;
-  * block_s sweep — more lanes per block raises SIMD width utilization.
+  * the ANALYTIC per-entry roofline table — arithmetic intensity
+    (flops / bytes accessed) straight from the compiled executables via
+    gome_tpu.obs.costmodel, replacing the hand-derived estimates this
+    script used to carry. Printed first on every run; `--table` prints
+    it alone (works on any backend, CPU included).
+  * the MEASURED sweep: times the compiled Pallas match kernel at the
+    headline shape while sweeping the knobs that distinguish the
+    candidate ceilings:
 
-Prints one JSON line per point: {cap, block_t, block_s, orders_per_sec,
-cycles_per_block_step} (cycles = block_s * f / throughput, f = 940 MHz
-for v5e — the serial per-step critical path the dependency chain pays).
+      - cap sweep    — per-step work is O(cap) vector ops over
+                       [block_s, cap] tiles; if throughput scales ~1/cap
+                       the kernel is compute/dependency-bound, not
+                       launch-bound;
+      - block_t sweep — deeper time blocks amortize grid/launch overhead;
+                       a plateau means launches are not the ceiling;
+      - block_s sweep — more lanes per block raises SIMD utilization.
+
+    Prints one JSON line per point: {cap, block_t, block_s,
+    orders_per_sec, cycles_per_block_step} (cycles = block_s * f /
+    throughput, f = 940 MHz for v5e — the serial per-step critical path
+    the dependency chain pays).
 """
 
 import json
@@ -95,7 +105,50 @@ def run_point(cap, block_s, block_t):
     return rate
 
 
+def analytic_table(dtype="int32"):
+    """Per-entry roofline table from the compiled executables'
+    cost/memory analysis (gome_tpu.obs.costmodel) — the measured
+    arithmetic intensity each entry presents to the memory system, not a
+    hand count. An intensity far below the machine balance (~100s of
+    flops/byte on TPU) confirms these integer kernels are bandwidth/
+    dependency-bound, which is why the sweeps below probe launch and
+    blocking overheads rather than FLOP ceilings."""
+    from gome_tpu.obs import costmodel
+
+    rows = [r for r in costmodel.entry_report(dtype) if "error" not in r]
+    print(f"# analytic roofline ({dtype}, canonical envelope geometry)")
+    print(
+        "# {:<26} {:>10} {:>12} {:>10} {:>12} {:>10}".format(
+            "entry", "flops/ord", "bytes/ord", "flops/byte", "peak_hbm_B",
+            "jaxpr_ops",
+        )
+    )
+    for r in rows:
+        fmt = lambda v, p=1: "-" if v is None else f"{v:.{p}f}"
+        print(
+            "# {:<26} {:>10} {:>12} {:>10} {:>12} {:>10}".format(
+                r["entry"],
+                fmt(r.get("flops_per_order")),
+                fmt(r.get("bytes_per_order")),
+                fmt(r.get("arithmetic_intensity"), 3),
+                str(r.get("peak_hbm_bytes")),
+                str(r.get("jaxpr_eqns")),
+            )
+        )
+    for d in costmodel.donation_report(dtype):
+        if "error" not in d:
+            print(
+                f"# donation {d['entry']}: peak "
+                f"{d['public_peak_hbm_bytes']} -> "
+                f"{d['donating_peak_hbm_bytes']} B "
+                f"(saved {d['peak_hbm_saved_bytes']})"
+            )
+
+
 def main():
+    analytic_table(os.environ.get("ROOFLINE_DTYPE", "int32"))
+    if "--table" in sys.argv:
+        return
     # Headline point + cap sweep at fixed blocking.
     for cap in (64, 128, 256, 512):
         run_point(cap, 128, min(T, 16))

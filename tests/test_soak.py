@@ -40,3 +40,38 @@ def test_soak_mixed_stream_with_restore_and_invariants():
             engine.import_state(state)
     assert got == expected
     assert len(got) > 500  # the stream actually matched at volume
+
+
+def test_soak_steady_state_live_buffers_flat():
+    """Leak detector (gome_tpu.obs.live) on real engine steps: once the
+    flow's shapes and escalations have settled, N further engine steps
+    must leave the live device-buffer count FLAT — a growing count is a
+    leaked buffer (a retained checkpoint, an accumulator outliving its
+    frame). The settle phase absorbs the legitimate allocators: first-
+    seen compiles (their executables pin constant buffers) and book/cap
+    growth."""
+    from gome_tpu.obs import live
+
+    engine = BatchEngine(
+        BookConfig(cap=64, max_fills=8, dtype=jnp.int32), n_slots=8,
+        max_t=32,
+    )
+    # Cancel-heavy stationary flow (resting depth stays bounded, so no
+    # mid-measurement cap escalation mints fresh executables).
+    orders = multi_symbol_stream(
+        n=2000, n_symbols=8, seed=23, cancel_prob=0.5
+    )
+    chunks = [orders[i : i + 250] for i in range(0, len(orders), 250)]
+    i = 0
+
+    def step():
+        nonlocal i
+        engine.process_columnar(chunks[i % len(chunks)])
+        i += 1
+
+    # settle = one full pass (every chunk's shapes compile + books reach
+    # steady depth), then the whole second pass must hold the baseline.
+    report = live.assert_steady_state(
+        step, steps=len(chunks), settle=len(chunks)
+    )
+    assert report["counts"], report
