@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import json
 import os
+import resource
 import sys
 import time
 
@@ -679,79 +680,126 @@ def service_main():
 
     from gome_tpu.bus.colwire import decode_event_frame
 
-    def run_stream(label, make_frame):
-        """Warm (off clock) then time one stream: gateway phase + consumer
-        drain. Returns the measurement dict and prints the stderr
-        breakdown. process_time tracks the CPU this process actually
-        spent (excludes time blocked on the tunnel AND CPU stolen by the
-        tunnel proxy — the stable cost measure on a contended 1-core dev
-        host)."""
+    def run_stream(label, make_frame, repeats=1):
+        """Warm (off clock) then time one stream REPEATS times: gateway
+        phase + consumer drain per repeat. Returns the MEDIAN repeat's
+        measurement dict (by throughput) extended with the per-run list,
+        per-run getrusage deltas, and a per-frame consumer CPU-time
+        histogram — VERDICT r5 #1/#2: a headline must be a median with
+        contention telemetry attached, not a best-of-N outlier with no
+        record of what the host was doing. process_time tracks the CPU
+        this process actually spent (excludes time blocked on the tunnel
+        AND CPU stolen by the tunnel proxy — the stable cost measure on
+        a contended 1-core dev host)."""
         n_warm = _svc_warmup(
             engine, consumer, bus, make_frame, symbols,
             margin=not have_manifest,
         )
-        frames_cols = [make_frame() for _ in range(-(-N // FRAME))]
-        n_total = sum(int(c["n"]) for c in frames_cols)
-        engine_frames.FETCH_SECONDS = 0.0
-        ev_skip = bus.match_queue.end_offset()  # warmup frames' events
-        st0 = (
-            engine.stats.device_calls,
-            engine.stats.cap_escalations,
-            engine.stats.frame_fallbacks,
-        )
+        runs = []
+        cpu_frame: list[float] = []  # consumer CPU seconds per frame step
+        for _rep in range(max(1, repeats)):
+            frames_cols = [make_frame() for _ in range(-(-N // FRAME))]
+            n_total = sum(int(c["n"]) for c in frames_cols)
+            engine_frames.FETCH_SECONDS = 0.0
+            ev_skip = bus.match_queue.end_offset()  # prior frames' events
+            st0 = (
+                engine.stats.device_calls,
+                engine.stats.cap_escalations,
+                engine.stats.frame_fallbacks,
+            )
+            ru0 = resource.getrusage(resource.RUSAGE_SELF)
 
-        # Gateway phase (timed): encode + mark + publish every frame.
-        t0 = time.perf_counter()
-        for cols in frames_cols:
-            _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
-        t_gateway = time.perf_counter() - t0
+            # Gateway phase (timed): encode + mark + publish every frame.
+            t0 = time.perf_counter()
+            for cols in frames_cols:
+                _svc_gateway_step(
+                    cols, symbols, engine.pre_pool, bus.order_queue
+                )
+            t_gateway = time.perf_counter() - t0
 
-        t0 = time.perf_counter()
-        c0 = time.process_time()
-        n_done = consumer.drain()
-        t_consumer = time.perf_counter() - t0
-        cpu_consumer = time.process_time() - c0
-        fetch_s = engine_frames.FETCH_SECONDS
-        elapsed = t_gateway + t_consumer
-        assert n_done == n_total, (n_done, n_total)
+            # Consumer phase (timed), step by step: batch_n=1 means one
+            # run_once ≈ one frame, so the per-step process_time delta IS
+            # the per-frame CPU cost — the distribution the median
+            # headline needs next to it (a flat median with a fat p99
+            # tail is a contention story, not a throughput story).
+            t0 = time.perf_counter()
+            c0 = time.process_time()
+            n_done = 0
+            while (
+                bus.order_queue.committed() < bus.order_queue.end_offset()
+            ):
+                s0 = time.process_time()
+                n_step = consumer.run_once()
+                dt = time.process_time() - s0
+                if n_step:
+                    cpu_frame.append(dt)
+                n_done += n_step
+            t_consumer = time.perf_counter() - t0
+            cpu_consumer = time.process_time() - c0
+            ru1 = resource.getrusage(resource.RUSAGE_SELF)
+            fetch_s = engine_frames.FETCH_SECONDS
+            elapsed = t_gateway + t_consumer
+            assert n_done == n_total, (n_done, n_total)
 
-        n_events = 0
-        ev_bytes = 0
-        for m in bus.match_queue.read_from(ev_skip, 1 << 30):
-            ev_bytes += len(m.body)
-            n_events += len(decode_event_frame(m.body))
-        host_s = max(elapsed - fetch_s, 1e-9)
-        meas = dict(
-            label=label,
-            orders=n_done,
-            events=n_events,
-            throughput=n_done / elapsed,
-            ex_fetch=n_done / host_s,
-            consumer_cpu_orders_per_sec_per_core=(
-                n_done / max(cpu_consumer, 1e-9)
-            ),
-            gateway_s=t_gateway,
-            consumer_s=t_consumer,
-            consumer_cpu_s=cpu_consumer,
-            fetch_blocked_s=fetch_s,
-        )
-        print(
-            f"# [{label}] orders={n_done} events={n_events} "
-            f"warm_frames={n_warm} gateway={t_gateway:.3f}s "
-            f"consumer={t_consumer:.3f}s fetch_blocked={fetch_s:.3f}s "
-            f"(dev-tunnel link) | ex-fetch {n_done / host_s / 1e6:.2f}M "
-            f"orders/sec | "
-            f"consumer-only ex-fetch "
-            f"{n_done / max(t_consumer - fetch_s, 1e-9) / 1e6:.2f}M | "
-            f"event-frame bytes/order={ev_bytes / max(n_done, 1):.1f} | "
-            f"device_calls={engine.stats.device_calls - st0[0]} "
-            f"escalations={engine.stats.cap_escalations - st0[1]} "
-            f"fallbacks={engine.stats.frame_fallbacks - st0[2]} "
-            f"cap={engine.config.cap} | "
-            f"consumer_cpu={cpu_consumer:.3f}s -> "
-            f"{n_done / max(cpu_consumer, 1e-9) / 1e6:.2f}M orders/sec/core",
-            file=sys.stderr,
-        )
+            n_events = 0
+            ev_bytes = 0
+            for m in bus.match_queue.read_from(ev_skip, 1 << 30):
+                ev_bytes += len(m.body)
+                n_events += len(decode_event_frame(m.body))
+            host_s = max(elapsed - fetch_s, 1e-9)
+            runs.append(dict(
+                label=label,
+                orders=n_done,
+                events=n_events,
+                throughput=n_done / elapsed,
+                ex_fetch=n_done / host_s,
+                consumer_cpu_orders_per_sec_per_core=(
+                    n_done / max(cpu_consumer, 1e-9)
+                ),
+                gateway_s=t_gateway,
+                consumer_s=t_consumer,
+                consumer_cpu_s=cpu_consumer,
+                fetch_blocked_s=fetch_s,
+                rusage=dict(
+                    utime_s=round(ru1.ru_utime - ru0.ru_utime, 6),
+                    stime_s=round(ru1.ru_stime - ru0.ru_stime, 6),
+                    nvcsw=ru1.ru_nvcsw - ru0.ru_nvcsw,
+                    nivcsw=ru1.ru_nivcsw - ru0.ru_nivcsw,
+                    majflt=ru1.ru_majflt - ru0.ru_majflt,
+                ),
+            ))
+            print(
+                f"# [{label} {_rep + 1}/{max(1, repeats)}] "
+                f"orders={n_done} events={n_events} "
+                f"warm_frames={n_warm} gateway={t_gateway:.3f}s "
+                f"consumer={t_consumer:.3f}s fetch_blocked={fetch_s:.3f}s "
+                f"(dev-tunnel link) | ex-fetch "
+                f"{n_done / host_s / 1e6:.2f}M orders/sec | "
+                f"consumer-only ex-fetch "
+                f"{n_done / max(t_consumer - fetch_s, 1e-9) / 1e6:.2f}M | "
+                f"event-frame bytes/order={ev_bytes / max(n_done, 1):.1f} "
+                f"| device_calls={engine.stats.device_calls - st0[0]} "
+                f"escalations={engine.stats.cap_escalations - st0[1]} "
+                f"fallbacks={engine.stats.frame_fallbacks - st0[2]} "
+                f"cap={engine.config.cap} | "
+                f"consumer_cpu={cpu_consumer:.3f}s -> "
+                f"{n_done / max(cpu_consumer, 1e-9) / 1e6:.2f}M "
+                f"orders/sec/core | nivcsw={runs[-1]['rusage']['nivcsw']}",
+                file=sys.stderr,
+            )
+        ordered = sorted(runs, key=lambda r: r["throughput"])
+        meas = dict(ordered[len(ordered) // 2])  # the median run
+        meas["runs"] = runs
+        meas["median_throughput"] = meas["throughput"]
+        meas["best_throughput"] = ordered[-1]["throughput"]
+        cf = np.asarray(cpu_frame, np.float64)
+        if len(cf):
+            p50, p90, p99 = np.percentile(cf, [50, 90, 99])
+            meas["cpu_per_frame_s"] = dict(
+                count=len(cf), mean=round(float(cf.mean()), 6),
+                p50=round(float(p50), 6), p90=round(float(p90), 6),
+                p99=round(float(p99), 6), max=round(float(cf.max()), 6),
+            )
         return meas
 
     # Clean stream first (pure limit ADDs, uniform symbols — the upper
@@ -769,24 +817,47 @@ def service_main():
 
     clean = run_stream("clean", clean_frame)
     mixed_flow = _MixedFlow(np.random.default_rng(11), S)
-    mixed = run_stream("mixed", lambda: mixed_flow.frame(FRAME))
+    # The HEADLINE is the MEDIAN of SVC_REPEATS timed repeats (VERDICT r5
+    # #1/#2): one repeat is a sample, not a claim — the best repeat stays
+    # in the payload as a secondary field, next to the per-run rusage
+    # deltas (nivcsw = the contention record) and the per-frame CPU
+    # histogram that say WHY the spread is what it is.
+    REPEATS = int(os.environ.get("SVC_REPEATS", 5))
+    mixed = run_stream(
+        "mixed", lambda: mixed_flow.frame(FRAME), repeats=REPEATS
+    )
     try:
         engine.save_geometry(geom_path)
     except OSError as e:
         print(f"# geometry manifest not saved: {e}", file=sys.stderr)
 
-    throughput = mixed["throughput"]
+    throughput = mixed["median_throughput"]
     result = {
         "metric": (
             "service throughput gateway->matchOrder, MIXED stream "
             f"(Zipf symbols, ~45% cancels incl. same-frame races, ~25% "
             f"market orders, 256 uuids; everything after gRPC arrival), "
             f"{S} symbols, {FRAME}-order frames, int32 pallas, pipeline "
-            f"depth {PIPE}"
+            f"depth {PIPE}; MEDIAN of {REPEATS} timed repeats"
         ),
         "value": round(throughput),
         "unit": "orders/sec",
         "vs_baseline": round(throughput / 1_000_000, 3),
+        "best_of_runs": round(mixed["best_throughput"]),
+        "runs": [
+            {
+                "throughput": round(r["throughput"]),
+                "consumer_cpu_orders_per_sec_per_core": round(
+                    r["consumer_cpu_orders_per_sec_per_core"]
+                ),
+                "gateway_s": round(r["gateway_s"], 3),
+                "consumer_s": round(r["consumer_s"], 3),
+                "fetch_blocked_s": round(r["fetch_blocked_s"], 3),
+                "rusage": r["rusage"],
+            }
+            for r in mixed["runs"]
+        ],
+        "cpu_per_frame_s": mixed.get("cpu_per_frame_s"),
     }
     analytic = _analytic_block("int32")
     if analytic is not None:

@@ -13,8 +13,11 @@ class MemoryQueue(_Waitable, Queue):
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
+        # The log: _items[i] holds offset _base + i. compact() releases
+        # the committed prefix (advances _base); offsets stay absolute.
         self._items: list[bytes] = []  # guarded by self._lock
         self._headers: list[dict | None] = []  # guarded by self._lock
+        self._base = 0  # guarded by self._lock
         self._committed = 0  # guarded by self._lock
         self._init_wait()
 
@@ -22,23 +25,31 @@ class MemoryQueue(_Waitable, Queue):
         with self._lock:
             self._items.append(bytes(body))
             self._headers.append(headers)
-            off = len(self._items) - 1
+            off = self._base + len(self._items) - 1
         self._notify_publish()
         return off
 
     def read_from(self, offset: int, max_n: int) -> list[Message]:
         with self._lock:
-            end = min(len(self._items), offset + max_n)
+            if offset < self._base:
+                raise ValueError(
+                    f"offset {offset} was compacted away (base "
+                    f"{self._base}); compact() only frees the committed "
+                    "prefix, so a committed reader can never see this"
+                )
+            end = min(len(self._items), offset - self._base + max_n)
             return [
                 Message(
-                    offset=i, body=self._items[i], headers=self._headers[i]
+                    offset=self._base + i,
+                    body=self._items[i],
+                    headers=self._headers[i],
                 )
-                for i in range(offset, end)
+                for i in range(offset - self._base, end)
             ]
 
     def end_offset(self) -> int:
         with self._lock:
-            return len(self._items)
+            return self._base + len(self._items)
 
     def committed(self) -> int:
         with self._lock:
@@ -50,9 +61,10 @@ class MemoryQueue(_Waitable, Queue):
                 raise ValueError(
                     f"commit going backwards: {offset} < {self._committed}"
                 )
-            if offset > len(self._items):
+            if offset > self._base + len(self._items):
                 raise ValueError(
-                    f"commit past end: {offset} > {len(self._items)}"
+                    f"commit past end: {offset} > "
+                    f"{self._base + len(self._items)}"
                 )
             self._committed = offset
 
@@ -62,7 +74,32 @@ class MemoryQueue(_Waitable, Queue):
                 raise ValueError(
                     f"rollback going forwards: {offset} > {self._committed}"
                 )
+            if offset < self._base:
+                raise ValueError(
+                    f"rollback below compacted base: {offset} < "
+                    f"{self._base} — compact() bounds the redelivery "
+                    "window to messages since the last compaction"
+                )
             self._committed = offset
+
+    def compact(self) -> int:
+        """Release the committed prefix (the memory-bus analog of a log
+        segment delete): message bodies below the committed offset are
+        freed and the base advances. Without this, an in-process queue
+        retains every message for the life of the process — fine for a
+        bounded bench, UNBOUNDED growth for a wall-clock soak (the
+        steady-state proof would be measuring its own harness). Bounds
+        the rollback/redelivery window to messages since the last
+        compaction — callers compact only past state they will never
+        replay. Returns the number of messages released."""
+        with self._lock:
+            n = self._committed - self._base
+            if n <= 0:
+                return 0
+            del self._items[:n]
+            del self._headers[:n]
+            self._base = self._committed
+            return n
 
     def truncate_to(self, offset: int) -> None:
         with self._lock:
@@ -71,5 +108,5 @@ class MemoryQueue(_Waitable, Queue):
                     f"cannot truncate below committed: {offset} < "
                     f"{self._committed}"
                 )
-            del self._items[offset:]
-            del self._headers[offset:]
+            del self._items[max(offset - self._base, 0):]
+            del self._headers[max(offset - self._base, 0):]

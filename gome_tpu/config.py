@@ -180,7 +180,14 @@ class OpsConfig:
     cost/cost_keep configure the device cost surface (gome_tpu.obs): with
     cost on, the compile journal is armed (gome_compile_seconds metrics +
     the /cost endpoint's journal section) keeping the last `cost_keep`
-    compile events."""
+    compile events.
+
+    timeline/timeline_interval_s/timeline_keep configure the host-side
+    timeline sampler (gome_tpu.obs.timeline): with timeline on, the
+    sampler is armed at boot and runs every `timeline_interval_s` seconds
+    on a daemon thread while the service is started, keeping the last
+    `timeline_keep` samples behind the /timeline endpoint and the
+    gome_timeline_* gauges."""
 
     host: str = "127.0.0.1"
     port: int = 9109
@@ -190,6 +197,9 @@ class OpsConfig:
     slow_ms: float = 50.0  # slow-order threshold (pinned in the slow ring)
     cost: bool = True  # arm the compile journal with the endpoint
     cost_keep: int = 256  # compile-journal ring size (events)
+    timeline: bool = True  # arm the host-side timeline sampler
+    timeline_interval_s: float = 1.0  # sampling period (seconds)
+    timeline_keep: int = 512  # timeline ring size (samples)
 
     def __post_init__(self) -> None:
         if self.trace_keep <= 0:
@@ -203,6 +213,16 @@ class OpsConfig:
         if self.cost_keep <= 0:
             raise ValueError(
                 f"ops.cost_keep must be positive, got {self.cost_keep}"
+            )
+        if self.timeline_interval_s <= 0:
+            raise ValueError(
+                f"ops.timeline_interval_s must be positive, got "
+                f"{self.timeline_interval_s}"
+            )
+        if self.timeline_keep <= 0:
+            raise ValueError(
+                f"ops.timeline_keep must be positive, got "
+                f"{self.timeline_keep}"
             )
 
 

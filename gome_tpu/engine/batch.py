@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..types import KERNELS, Action, MatchResult, Order
+from ..utils.metrics import REGISTRY
 from ..utils.trace import TRACER
 from .book import (
     BUY,
@@ -60,6 +61,27 @@ from .step import ACTION_ADD, _Side, step_rows_impl
 # freed.
 warnings.filterwarnings(
     "ignore", message="Some donated buffers were not usable"
+)
+
+#: Dense-dispatch skew telemetry (ROADMAP open item 2): each dense grid
+#: observes dispatched-rows / live-lanes — the row-padding tax the pow2
+#: bucketing (and, under a mesh, the per-shard MAX bucketing that
+#: `scripts/mesh_overhead.py --skew` measures at 3.7x for D=8 Zipf) makes
+#: the device pay. The p50 gauge is the placement target the ROADMAP sets
+#: (<= 2.0); the histogram carries the tail. Ratio buckets, not seconds.
+_ROWS_PER_LANE_BUCKETS = (
+    1.0, 1.25, 1.5, 2.0, 2.5, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0,
+)
+_rows_per_live_lane = REGISTRY.histogram(
+    "gome_dispatched_rows_per_live_lane",
+    "dense-grid dispatched rows per live lane (row-padding/skew tax)",
+    buckets=_ROWS_PER_LANE_BUCKETS,
+)
+REGISTRY.callback_gauge(
+    "gome_dispatched_rows_per_live_lane_p50",
+    "median dispatched-rows/live-lane across dense dispatches "
+    "(ROADMAP open item 2 targets <= 2.0)",
+    lambda: _rows_per_live_lane.quantile(0.5),
 )
 
 
@@ -915,6 +937,9 @@ class BatchEngine:
             lane_ids[rows_for_live] = live
         row_of = np.empty(self.n_slots, np.int64)
         row_of[live] = rows_for_live
+        # Skew telemetry: what row padding (pow2 bucket, grow-only floor,
+        # and per-shard MAX bucketing under a mesh) costs THIS dispatch.
+        _rows_per_live_lane.observe(n_rows / len(live))
         return True, n_rows, lane_ids, row_of
 
     def _admit_lane_range(self, lane: int, l: int, h: int) -> None:

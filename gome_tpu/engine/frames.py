@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs.compile_journal import JOURNAL, frame_combo_detail
+from ..obs.timeline import TIMELINE
 from ..types import Action, OrderType
 from ..utils.trace import TRACER
 from .batch import BatchEngine, _next_pow2, _next_pow4, splice_outs
@@ -412,6 +413,12 @@ def _tables(eng):
 def _assemble(eng, a, batches):
     from .events import EventBatch, empty_batch
 
+    # Timeline flow counters (obs.timeline): _assemble runs exactly once
+    # per applied frame on BOTH execution paths (apply_frame directly,
+    # the fast path via resolve_frame), so it is the one spot where a
+    # frame count cannot double on an exact-path fallback. Disabled
+    # sampler = one attribute check, zero allocations.
+    TIMELINE.note_frame(a["n"])
     eng.stats.orders += a["n"]
     if not batches:
         eng.stats.cancels_missed += a["dels_total"]

@@ -21,24 +21,33 @@ cooperating pieces:
     allocations.
   * ``live`` — tagged ``jax.live_arrays()`` snapshots (per-subsystem
     HBM-residency gauges) and a steady-state leak detector.
+  * ``timeline`` — the TIME axis (ISSUE 6): a bounded host-side sampler
+    recording RSS, getrusage deltas, live-buffer counts, compile totals,
+    queue depth, and the geometry-manifest hash over a run; exported as
+    the ops ``/timeline`` endpoint + ``gome_timeline_*`` gauges and
+    consumed by ``scripts/soak.py`` for the steady-state verdicts.
   * ``scripts/perf_ratchet.py`` — gates the deterministic analytic
     metrics (flops/order, bytes/order, peak HBM, compile count) against
     the committed ``PERF_BASELINE.json`` in CI.
 
 Import discipline: this ``__init__`` pulls in only ``compile_journal``
-(dependency-free) so ``engine.frames`` can import the JOURNAL singleton
-without a cycle; ``costmodel`` (which imports the engine) and ``live``
-load lazily on first attribute access.
+and ``timeline`` (both dependency-free) so ``engine.frames`` can import
+the JOURNAL/TIMELINE singletons without a cycle; ``costmodel`` (which
+imports the engine) and ``live`` load lazily on first attribute access.
 """
 
 from __future__ import annotations
 
 from .compile_journal import JOURNAL, CompileJournal, frame_combo_detail
+from .timeline import TIMELINE, TimelineSampler, service_timeline
 
 __all__ = [
     "JOURNAL",
     "CompileJournal",
     "frame_combo_detail",
+    "TIMELINE",
+    "TimelineSampler",
+    "service_timeline",
     "costmodel",
     "live",
 ]

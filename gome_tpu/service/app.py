@@ -126,6 +126,19 @@ class EngineService:
                 from ..obs.compile_journal import JOURNAL
 
                 JOURNAL.install(keep_n=self.config.ops.cost_keep)
+            if self.config.ops.timeline:
+                # Arm the host-side timeline sampler (gome_tpu.obs.
+                # timeline): RSS/rusage/live-buffer/compile/queue series
+                # behind the ops /timeline endpoint and gome_timeline_*
+                # gauges. The periodic thread runs only while the
+                # service is start()ed; sample() also works on demand.
+                from ..obs.timeline import TIMELINE, service_timeline
+
+                TIMELINE.install(
+                    interval_s=self.config.ops.timeline_interval_s,
+                    keep_n=self.config.ops.timeline_keep,
+                )
+                service_timeline(self)
             self.ops = OpsServer(
                 self, host=self.config.ops.host, port=self.config.ops.port
             )
@@ -140,6 +153,10 @@ class EngineService:
         self.feed.start()
         if self.ops is not None:
             self.ops.start()
+            if self.config.ops.timeline:
+                from ..obs.timeline import TIMELINE
+
+                TIMELINE.start()
         return self
 
     def stop(self):
@@ -150,6 +167,10 @@ class EngineService:
         self.feed.stop()
         if self.ops is not None:
             self.ops.stop()
+            if self.config.ops.timeline:
+                from ..obs.timeline import TIMELINE
+
+                TIMELINE.stop()
 
     def wait(self):
         if self._server is not None:

@@ -108,6 +108,12 @@ class FrameBatcher:
             lambda: len(self._spill),  # gomelint: disable=GL402 — see above
         )
         REGISTRY.callback_gauge(
+            "gome_gateway_buffered_orders",
+            "orders buffered in the batcher awaiting a frame flush "
+            "(the batching-bridge queue depth)",
+            lambda: len(self._buf),  # gomelint: disable=GL402 — see above
+        )
+        REGISTRY.callback_gauge(
             "gome_gateway_degraded_seconds",
             "seconds the gateway has been in degraded mode (0 healthy)",
             lambda: (

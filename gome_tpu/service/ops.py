@@ -1,10 +1,11 @@
 """Operator HTTP endpoint: /metrics (Prometheus text format from
 utils.metrics.REGISTRY), /healthz (service.health.HealthMonitor JSON),
 /trace (the order-lifecycle flight recorder as Chrome trace-event JSON —
-load the dump in chrome://tracing or https://ui.perfetto.dev), and /cost
+load the dump in chrome://tracing or https://ui.perfetto.dev), /cost
 (device-level attribution JSON: the compile journal, live-buffer
 residency, and the XLA cost model incl. the donation-effectiveness
-report — gome_tpu.obs).
+report — gome_tpu.obs), and /timeline (the host-side steady-state
+sampler's bounded series — gome_tpu.obs.timeline).
 
 The reference has no observability surface at all (SURVEY §5.5 — logging
 only); this is the cheap operator-facing extension the TPU service ships:
@@ -14,6 +15,7 @@ one stdlib ThreadingHTTPServer, no dependencies, curl-able:
     curl localhost:9109/healthz     # 200 healthy / 503 unhealthy
     curl localhost:9109/trace > trace.json   # open in Perfetto
     curl localhost:9109/cost        # compiles + HBM + per-entry cost
+    curl localhost:9109/timeline    # RSS/rusage/live-buffer time series
 
 Enabled by an `ops:` section in config.yaml (port, host) or by
 constructing OpsServer directly around any EngineService.
@@ -96,6 +98,15 @@ class OpsServer:
             payload["cost_model"] = {"error": str(exc)}
         return payload
 
+    def timeline_payload(self) -> dict:
+        """The /timeline JSON document: the process-global timeline
+        sampler's bounded series (gome_tpu.obs.timeline.TIMELINE —
+        {"enabled", "interval_s", "samples": [...]}; empty but valid
+        while the sampler is disabled)."""
+        from ..obs.timeline import TIMELINE
+
+        return TIMELINE.as_dict()
+
     def start(self) -> "OpsServer":
         ops = self
 
@@ -138,6 +149,11 @@ class OpsServer:
                             ops.cost_payload(), default=str
                         ).encode()
                         self._send(200, body, "application/json")
+                    elif self.path.split("?")[0] == "/timeline":
+                        body = json.dumps(
+                            ops.timeline_payload(), default=str
+                        ).encode()
+                        self._send(200, body, "application/json")
                     elif self.path.split("?")[0] == "/trace":
                         rec = ops.tracer.recorder
                         dump = (
@@ -163,7 +179,7 @@ class OpsServer:
         )
         self._thread.start()
         log.info("ops endpoint up on %s:%d (/metrics, /healthz, /trace, "
-                 "/cost)", self.host, self.port)
+                 "/cost, /timeline)", self.host, self.port)
         return self
 
     def stop(self) -> None:

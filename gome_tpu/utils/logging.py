@@ -19,6 +19,27 @@ LOG_FILE = "order.log"  # logger.go:14 — same default file name
 #: reads it): any of 1/true/yes/on enables.
 JSON_ENV = "GOME_LOG_JSON"
 
+#: Env override for WHERE order.log lands (configure(log_dir=None) reads
+#: it). The reference drops the file in the CWD; that kept re-littering
+#: this repo's root whenever a test or script booted a service from it.
+DIR_ENV = "GOME_LOG_DIR"
+
+
+def _default_log_dir() -> str:
+    """Directory for the log file when the caller names none: the
+    GOME_LOG_DIR env override first; under pytest, the system tmp dir
+    (a test run must never litter the checkout — a stray order.log
+    reappeared in the repo root exactly this way); otherwise the CWD
+    (empty string — reference behavior, logger.go:14)."""
+    d = os.environ.get(DIR_ENV)
+    if d:
+        return d
+    if "PYTEST_CURRENT_TEST" in os.environ or "pytest" in sys.modules:
+        import tempfile
+
+        return tempfile.gettempdir()
+    return ""
+
 
 class JsonLineFormatter(logging.Formatter):
     """One JSON object per line: ts (unix seconds), level, logger, msg —
@@ -53,12 +74,15 @@ def configure(
     log_file: str | None = LOG_FILE,
     level: int = logging.INFO,
     json_lines: bool | None = None,
+    log_dir: str | None = None,
 ) -> None:
     """Idempotent root setup: file + stderr handlers (logger.go:17-22's
     io.MultiWriter). Call once at process start; get_logger works either
     way (falls back to stderr-only if never configured). json_lines
     selects the JSON-lines formatter (None: the GOME_LOG_JSON env var
-    decides) — each record then carries the current trace id."""
+    decides) — each record then carries the current trace id. log_dir
+    places the file (None: GOME_LOG_DIR env, then tmp under pytest,
+    then CWD — _default_log_dir); the directory is created if needed."""
     global _CONFIGURED
     if _CONFIGURED:
         return
@@ -74,7 +98,12 @@ def configure(
     stderr.setFormatter(fmt)
     root.addHandler(stderr)
     if log_file:
-        fh = logging.FileHandler(log_file)
+        d = log_dir if log_dir is not None else _default_log_dir()
+        path = log_file
+        if d:
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, log_file)
+        fh = logging.FileHandler(path)
         fh.setFormatter(fmt)
         root.addHandler(fh)
     _CONFIGURED = True

@@ -293,6 +293,16 @@ class Histogram:
         with self._lock:
             return self._quantile_locked(q)
 
+    def percentiles(self, qs=(0.5, 0.9, 0.99)) -> dict:
+        """{"p50": ..., "p90": ..., ...} for the requested quantiles,
+        read under ONE lock acquisition (a concurrent observe between
+        per-quantile reads would make e.g. p90 < p50 possible). The
+        latency reports (scripts/soak.py, bench --latency) use this."""
+        with self._lock:
+            return {
+                f"p{q * 100:g}": self._quantile_locked(q) for q in qs
+            }
+
     def _quantile_locked(self, q: float) -> float:
         if self._n == 0:
             return 0.0

@@ -481,6 +481,20 @@ class Tracer:
             if h.value()["count"]
         }
 
+    def stage_percentiles(self, qs=(0.5, 0.9, 0.99)) -> dict:
+        """{stage: {"count", "mean", "p50", "p90", "p99", ...}} at the
+        requested quantiles — the measured per-stage latency block the
+        soak/latency reports publish (scripts/soak.py)."""
+        out = {}
+        for stage, h in self._hist.items():
+            v = h.value()
+            if not v["count"]:
+                continue
+            row = {"count": v["count"], "mean": v["mean"]}
+            row.update(h.percentiles(qs))
+            out[stage] = row
+        return out
+
 
 #: Process-global tracer (disabled until something installs a recorder).
 TRACER = Tracer()

@@ -10,6 +10,10 @@ in-process service stack and dump the operator surfaces to files —
                           frame drill through the fast path), live-buffer
                           residency, and the XLA cost model incl. the
                           donation-effectiveness report
+  <out_dir>/timeline.json the /timeline payload: host-side sampler
+                          series (RSS, rusage deltas, live buffers,
+                          compile totals, geometry hash) — sampled
+                          around the drill
 
     python scripts/obs_snapshot.py [out_dir=obs-artifacts]
 
@@ -54,12 +58,17 @@ def main(out_dir: str = "obs-artifacts") -> int:
     from gome_tpu.api import order_pb2 as pb
     from gome_tpu.config import Config, EngineConfig, OpsConfig
     from gome_tpu.obs.compile_journal import JOURNAL
+    from gome_tpu.obs.timeline import TIMELINE
     from gome_tpu.service.app import EngineService
     from gome_tpu.service.ops import OpsServer
     from gome_tpu.utils.metrics import REGISTRY
     from gome_tpu.utils.trace import TRACER
 
     os.makedirs(out_dir, exist_ok=True)
+    # The drill's order.log is an artifact, not litter: route the file
+    # handler into the output dir (utils.logging honors GOME_LOG_DIR)
+    # instead of the CWD the reference default would hit.
+    os.environ.setdefault("GOME_LOG_DIR", out_dir)
     cfg = Config(
         engine=EngineConfig(cap=32, n_slots=16, max_t=8, dtype="int32"),
         # ops.enabled arms the order-lifecycle tracer AND the compile
@@ -68,6 +77,10 @@ def main(out_dir: str = "obs-artifacts") -> int:
         ops=OpsConfig(enabled=True, trace=True, trace_keep=32),
     )
     svc = EngineService(cfg)
+    # ops.timeline armed the sampler at boot; the periodic thread only
+    # runs while the service is start()ed, so the drill samples manually
+    # (one baseline now, one after the traffic below).
+    TIMELINE.sample()
     # A handful of crossing + cancelled orders so every surface has data:
     # fills, a cancel notice, and complete ingress->publish journeys.
     for i in range(8):
@@ -111,14 +124,25 @@ def main(out_dir: str = "obs-artifacts") -> int:
     with open(os.path.join(out_dir, "trace.json"), "w") as f:
         json.dump(dump, f, indent=1)
 
-    # The /cost payload via the SAME code path the HTTP endpoint serves
-    # (OpsServer.cost_payload), without binding a socket.
-    cost = OpsServer(svc).cost_payload()
+    # The /cost and /timeline payloads via the SAME code paths the HTTP
+    # endpoint serves (OpsServer.cost_payload/timeline_payload), without
+    # binding a socket.
+    ops = OpsServer(svc)
+    cost = ops.cost_payload()
     assert cost["compile_journal"]["entries"], "compile journal is empty"
     assert cost["cost_model"].get("entries"), "cost model empty"
     assert cost["live_buffers"]["total"]["count"] > 0, "no live buffers?"
     with open(os.path.join(out_dir, "cost.json"), "w") as f:
         json.dump(cost, f, indent=1, default=str)
+
+    TIMELINE.sample()  # post-drill sample: the series shows the drill
+    timeline = ops.timeline_payload()
+    assert timeline["enabled"], "ops.timeline did not arm the sampler"
+    assert len(timeline["samples"]) >= 2, "timeline captured no series"
+    assert timeline["samples"][-1]["engine"]["geometry_hash"], timeline
+    with open(os.path.join(out_dir, "timeline.json"), "w") as f:
+        json.dump(timeline, f, indent=1, default=str)
+    assert "gome_timeline_rss_bytes" in metrics, "timeline gauges missing"
 
     journeys = {
         ev["args"]["trace_id"]
@@ -129,11 +153,13 @@ def main(out_dir: str = "obs-artifacts") -> int:
     print(
         f"wrote {out_dir}/metrics.txt ({len(metrics)} bytes), "
         f"{out_dir}/trace.json ({len(dump['traceEvents'])} events, "
-        f"{len(journeys)} journeys), and {out_dir}/cost.json "
+        f"{len(journeys)} journeys), {out_dir}/cost.json "
         f"({n_compiles} journaled compiles, "
-        f"{len(cost['cost_model']['entries'])} cost-model entries)"
+        f"{len(cost['cost_model']['entries'])} cost-model entries), and "
+        f"{out_dir}/timeline.json ({len(timeline['samples'])} samples)"
     )
     JOURNAL.disable()
+    TIMELINE.disable()
     return 0
 
 

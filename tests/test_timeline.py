@@ -1,0 +1,371 @@
+"""Timeline sampler (gome_tpu.obs.timeline): scripted-clock series and
+ring bounds, probe isolation, the disabled-no-alloc hot-path guard, the
+/timeline HTTP endpoint, geometry-hash stability semantics, memory-queue
+compaction, and the GOME_LOG_DIR override — the ISSUE 6 surface."""
+
+import json
+import sys
+import urllib.request
+
+import numpy as np
+
+import jax.numpy as jnp
+import pytest
+
+from gome_tpu.engine import frames
+from gome_tpu.engine.batch import BatchEngine
+from gome_tpu.engine.book import BookConfig
+from gome_tpu.obs.timeline import (
+    TIMELINE,
+    TimelineSampler,
+    geometry_manifest_hash,
+    host_rss_bytes,
+    service_timeline,
+)
+from gome_tpu.utils.metrics import Registry
+
+
+@pytest.fixture(autouse=True)
+def _timeline_disabled():
+    """Every test leaves the process-global sampler disabled (the
+    hot-path default other tests assume)."""
+    yield
+    TIMELINE.disable()
+    from gome_tpu.obs.compile_journal import JOURNAL
+
+    JOURNAL.disable()
+
+
+def _engine(cap=16, n_slots=8, max_t=8):
+    return BatchEngine(
+        BookConfig(cap=cap, max_fills=4, dtype=jnp.int32),
+        n_slots=n_slots, max_t=max_t,
+    )
+
+
+def _frame(n, n_symbols=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return dict(
+        n=n,
+        action=np.ones(n, np.int64),
+        side=rng.integers(0, 2, n).astype(np.int64),
+        kind=np.zeros(n, np.int64),
+        price=rng.integers(99_000, 101_000, n).astype(np.int64),
+        volume=rng.integers(1, 10, n).astype(np.int64),
+        symbols=[f"s{i}" for i in range(n_symbols)],
+        symbol_idx=rng.integers(0, n_symbols, n).astype(np.int64),
+        uuids=["u0"],
+        uuid_idx=np.zeros(n, np.int64),
+        oids=np.char.add("t", np.arange(n).astype("U8")).astype("S"),
+    )
+
+
+# --- sampler core ---------------------------------------------------------
+
+
+def test_scripted_clock_series_and_ring_bound():
+    """Samples carry the scripted clock, the host fields, and the flow
+    counters; the ring keeps exactly the last keep_n."""
+    ticks = iter(float(i) for i in range(100))
+    t = TimelineSampler()
+    t.install(
+        interval_s=0.5, keep_n=3, registry=Registry(),
+        clock=lambda: next(ticks),
+    )
+    t.register("probe", lambda: {"x": 1})
+    t.note_frame(40)
+    t.note_frame(2)
+    first = t.sample()
+    assert first["t"] == 0.0
+    assert first["frames"] == 2 and first["orders"] == 42
+    assert first["rss_bytes"] > 0
+    assert first["cpu_utime_s"] >= 0.0
+    for key in ("cpu_stime_s", "majflt", "nvcsw", "nivcsw", "ts"):
+        assert key in first
+    assert first["probe"] == {"x": 1}
+    for _ in range(5):
+        t.sample()
+    series = t.series()
+    assert len(series) == 3  # bounded ring, oldest evicted
+    assert [s["t"] for s in series] == [3.0, 4.0, 5.0]
+    assert t.latest()["t"] == 5.0
+    d = t.as_dict()
+    assert d["enabled"] is True and d["interval_s"] == 0.5
+    assert len(d["samples"]) == 3
+
+
+def test_disabled_sampler_is_inert():
+    t = TimelineSampler()  # never installed
+    assert not t.enabled
+    assert t.sample() is None
+    assert t.series() == []
+    assert t.latest() is None
+    assert t.as_dict() == {
+        "enabled": False, "interval_s": 1.0, "samples": [],
+    }
+    with pytest.raises(RuntimeError):
+        t.start()
+
+
+def test_install_validation_and_disable_clears():
+    t = TimelineSampler()
+    with pytest.raises(ValueError):
+        t.install(interval_s=0.0, registry=Registry())
+    with pytest.raises(ValueError):
+        t.install(keep_n=0, registry=Registry())
+    t.install(registry=Registry())
+    t.register("x", lambda: {})
+    t.note_frame(1)
+    t.sample()
+    t.disable()
+    assert not t.enabled
+    assert t.series() == []
+    assert t._probes == {}  # probe service references released
+
+
+def test_probe_error_is_isolated():
+    """One raising probe lands as {"error": ...}; the sample and every
+    other probe survive."""
+    t = TimelineSampler().install(registry=Registry())
+    t.register("bad", lambda: 1 / 0)
+    t.register("good", lambda: {"ok": True})
+    s = t.sample()
+    assert "error" in s["bad"]
+    assert s["good"] == {"ok": True}
+
+
+def test_timeline_gauges_exported():
+    reg = Registry()
+    t = TimelineSampler().install(registry=reg)
+    t.note_frame(7)
+    t.sample()
+    text = reg.render()
+    for name in (
+        "gome_timeline_rss_bytes",
+        "gome_timeline_cpu_seconds_total",
+        "gome_timeline_involuntary_ctx_switches_total",
+        "gome_timeline_major_faults_total",
+        "gome_timeline_samples",
+        "gome_timeline_frames_total",
+        "gome_timeline_orders_total",
+    ):
+        assert name in text, name
+    snap = reg.snapshot()
+    assert snap["gome_timeline_frames_total"] == 1.0
+    assert snap["gome_timeline_orders_total"] == 7.0
+    assert snap["gome_timeline_samples"] == 1.0
+    assert snap["gome_timeline_rss_bytes"] == pytest.approx(
+        host_rss_bytes(), rel=0.5
+    )
+
+
+# --- hot-path overhead guard (acceptance) ---------------------------------
+
+
+def test_disabled_sampler_hot_path_allocates_nothing():
+    """The disabled note_frame hook on the frame hot path is one
+    attribute check and zero allocations — same sys.getallocatedblocks
+    guard as the tracer and compile journal."""
+    t = TimelineSampler()  # never installed
+    assert not t.enabled
+
+    def drill(n):
+        i = 0
+        while i < n:
+            if t.enabled:
+                raise AssertionError("unreachable")
+            t.note_frame(256)
+            i += 1
+
+    drill(64)  # warm any lazy caches
+    before = sys.getallocatedblocks()
+    drill(200)
+    after = sys.getallocatedblocks()
+    assert after - before <= 2, f"hot-path hooks allocated {after - before}"
+
+
+def test_frame_path_feeds_flow_counters():
+    """The engine frame path (frames._assemble) reports into an armed
+    sampler — frames and orders accumulate."""
+    eng = _engine()
+    TIMELINE.install(registry=Registry())
+    frames.apply_frame_fast(eng, _frame(32, seed=1))
+    s = TIMELINE.sample()
+    assert s["frames"] == 1
+    assert s["orders"] == 32
+
+
+# --- geometry-manifest hash ----------------------------------------------
+
+
+def test_geometry_hash_stable_then_drifts_on_new_shapes():
+    eng = _engine()
+    h0 = geometry_manifest_hash(eng)
+    assert h0 == geometry_manifest_hash(eng)  # deterministic
+    frames.apply_frame_fast(eng, _frame(32, seed=2))
+    h1 = geometry_manifest_hash(eng)
+    assert h1 != h0  # first frame minted dispatch combos
+    frames.apply_frame_fast(eng, _frame(32, seed=3))
+    assert geometry_manifest_hash(eng) == h1  # same shapes: stable
+
+
+# --- service probes + /timeline HTTP -------------------------------------
+
+
+def test_timeline_http_validity():
+    from gome_tpu.config import Config, EngineConfig, OpsConfig
+    from gome_tpu.service.app import EngineService
+
+    cfg = Config(
+        engine=EngineConfig(cap=16, max_fills=4, n_slots=4, max_t=4,
+                            dtype="int32"),
+        ops=OpsConfig(port=0, enabled=True, timeline_interval_s=0.25),
+    )
+    svc = EngineService(cfg)
+    assert TIMELINE.enabled  # ops.timeline armed the sampler at boot
+    frames.apply_frame_fast(svc.engine.batch, _frame(16, seed=4))
+    TIMELINE.sample()
+    svc.ops.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.ops.port}/timeline", timeout=30
+        ) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            doc = json.loads(r.read().decode())
+        assert doc["enabled"] is True
+        assert doc["interval_s"] == 0.25
+        assert doc["samples"], "no samples over HTTP"
+        s = doc["samples"][-1]
+        assert s["rss_bytes"] > 0
+        assert s["frames"] >= 1 and s["orders"] >= 16
+        assert s["engine"]["geometry_hash"]
+        assert s["engine"]["cap"] == 16
+        assert s["live"]["count"] > 0
+        assert "compiles" in s["compile"]
+        assert s["queue"]["order_backlog"] == 0
+        # /metrics carries the gome_timeline_* families too
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.ops.port}/metrics", timeout=10
+        ) as r:
+            metrics = r.read().decode()
+        assert "gome_timeline_rss_bytes" in metrics
+        assert "gome_timeline_orders_total" in metrics
+    finally:
+        svc.ops.stop()
+
+
+def test_service_timeline_batcher_probe():
+    """With a FrameBatcher on the gateway, the batcher probe reports
+    queue depth + degraded state."""
+    import types
+
+    from gome_tpu.bus import MemoryQueue, QueueBus
+    from gome_tpu.service.batcher import FrameBatcher
+
+    bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    batcher = FrameBatcher(bus.order_queue, max_n=64, max_wait_s=60)
+    try:
+        eng = _engine()
+        t = TimelineSampler().install(registry=Registry())
+        service_timeline(
+            types.SimpleNamespace(
+                engine=eng, bus=bus,
+                gateway=types.SimpleNamespace(batcher=batcher),
+            ),
+            sampler=t,
+        )
+        from gome_tpu.types import Action, Order, OrderType, Side
+
+        batcher.submit(Order(
+            uuid="u", oid="o1", symbol="s", side=Side.BUY, price=100,
+            volume=1, action=Action.ADD, order_type=OrderType.LIMIT,
+        ))
+        s = t.sample()
+        assert s["batcher"]["buffered"] == 1
+        assert s["batcher"]["degraded"] is False
+        assert s["batcher"]["spill_depth"] == 0
+    finally:
+        batcher.close()
+
+
+# --- periodic thread ------------------------------------------------------
+
+
+def test_sampler_thread_collects_and_stops():
+    t = TimelineSampler().install(interval_s=0.01, registry=Registry())
+    t.start()
+    import time as _time
+
+    deadline = _time.monotonic() + 5.0
+    while len(t.series()) < 3 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    t.stop()
+    n = len(t.series())
+    assert n >= 3, "thread collected no samples"
+    _time.sleep(0.05)
+    assert len(t.series()) == n  # stopped means stopped
+
+
+# --- memory-queue compaction (the soak harness's bounded-bus contract) ----
+
+
+def test_memory_queue_compact_releases_committed_prefix():
+    from gome_tpu.bus.memory import MemoryQueue
+
+    q = MemoryQueue("x")
+    for i in range(10):
+        q.publish(bytes([i]))
+    q.commit(6)
+    assert q.compact() == 6
+    assert q.end_offset() == 10
+    assert q.committed() == 6
+    # offsets stay absolute across compaction
+    msgs = q.read_from(6, 100)
+    assert [m.offset for m in msgs] == [6, 7, 8, 9]
+    assert [m.body for m in msgs] == [bytes([i]) for i in range(6, 10)]
+    with pytest.raises(ValueError):
+        q.read_from(3, 1)  # compacted away
+    with pytest.raises(ValueError):
+        q.rollback(3)  # redelivery window is bounded by compaction
+    assert q.compact() == 0  # idempotent at the committed offset
+    q.publish(b"z")
+    assert q.publish(b"z2") == 11
+    q.commit(11)
+    assert q.compact() == 5
+
+
+# --- GOME_LOG_DIR ---------------------------------------------------------
+
+
+def test_log_dir_override(tmp_path, monkeypatch):
+    """configure() honors GOME_LOG_DIR — no more order.log littering the
+    CWD (stray-file regression from PR 5's cleanup)."""
+    import logging as _logging
+
+    from gome_tpu.utils import logging as gl
+
+    root = _logging.getLogger("gome_tpu")
+    before = list(root.handlers)
+    monkeypatch.setattr(gl, "_CONFIGURED", False)
+    monkeypatch.setenv("GOME_LOG_DIR", str(tmp_path / "logs"))
+    try:
+        gl.configure()
+        assert (tmp_path / "logs" / "order.log").exists()
+    finally:
+        for h in root.handlers[len(before):]:
+            h.close()
+        root.handlers[:] = before
+
+
+def test_log_dir_default_is_tmp_under_pytest(tmp_path, monkeypatch):
+    """Without an explicit override, a pytest run logs to the system tmp
+    dir, never the checkout."""
+    import tempfile
+
+    from gome_tpu.utils import logging as gl
+
+    monkeypatch.delenv("GOME_LOG_DIR", raising=False)
+    assert gl._default_log_dir() == tempfile.gettempdir()
+    monkeypatch.setenv("GOME_LOG_DIR", str(tmp_path))
+    assert gl._default_log_dir() == str(tmp_path)
