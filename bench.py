@@ -483,6 +483,105 @@ class _MixedFlow:
         )
 
 
+class _SimFlow:
+    """gome_tpu.sim traffic source (--flow sim / BENCH_FLOW=sim): the
+    on-device Hawkes/Zipf generator drives the service bench instead of
+    the hand-rolled _MixedFlow — clustered (self-exciting) arrivals,
+    Zipf(a) symbol popularity, book-coupled limit placement, and cancels
+    that target really-resting (symbol, uuid, oid, price) quadruples.
+    Generation is the load client's job and stays off the clock: each
+    pump runs one device gen step, applies the grid to a sim-side book
+    stack (so later grids quote against the evolved state), then
+    converts to the service column contract via sim.replay's
+    grid_to_columns (deliberate-miss cancels dropped — the pre-pool
+    tracks oid liveness). `.frame(n)` buffers pumps until it can hand
+    out exactly n orders; the surplus carries into the next frame."""
+
+    T_BINS = 1024  # one thinned event max per bin -> <= 1024 orders/pump
+
+    def __init__(self, seed, n_symbols):
+        import jax
+        import jax.numpy as jnp
+
+        from gome_tpu.engine.batch import batch_step
+        from gome_tpu.engine.book import BookConfig, init_books
+        from gome_tpu.sim.flow import FlowConfig, flow_init, gen_ops_jit
+        from gome_tpu.sim.replay import grid_to_columns
+
+        self._apply = batch_step
+        self._gen = gen_ops_jit
+        self._to_cols = grid_to_columns
+        self._get = jax.device_get
+        self.seed = seed
+        self.config = FlowConfig(
+            n_lanes=n_symbols,
+            t_bins=self.T_BINS,
+            ref_price=100_000_000,  # match the service price magnitude
+            ref_spread=50,
+        )
+        # Generation-side books are independent of the engine under test
+        # (the load client does not see the matcher's state); cap 64 is
+        # deep enough that cancel targets come from a faithful book.
+        self.book_config = BookConfig(cap=64, max_fills=8, dtype=jnp.int32)
+        self.books = init_books(self.book_config, n_symbols)
+        self.state = flow_init(self.config, jax.random.PRNGKey(seed))
+        self._buf = []
+        self._buffered = 0
+
+    def _pump(self):
+        self.state, ops = self._gen(self.config, self.state, self.books)
+        self.books, _ = self._apply(self.book_config, self.books, ops)
+        cols = self._to_cols(
+            self._get(ops)._asdict(), drop_misses=True
+        )
+        if cols["n"]:
+            self._buf.append(cols)
+            self._buffered += cols["n"]
+
+    def frame(self, n):
+        while self._buffered < n:
+            self._pump()
+        cat = {
+            k: np.concatenate([b[k] for b in self._buf])
+            for k in self._buf[0]
+            if k != "n"
+        }
+        out = {k: v[:n] for k, v in cat.items()}
+        out["n"] = n
+        rest = {k: v[n:] for k, v in cat.items()}
+        m = len(rest["action"])
+        self._buf = [dict(rest, n=m)] if m else []
+        self._buffered = m
+        return out
+
+    def describe(self):
+        """Flow provenance for the bench JSON payload (enough to rebuild
+        the FlowConfig and regenerate the stream bit-exactly)."""
+        c = self.config
+        return {
+            "kind": "sim",
+            "seed": self.seed,
+            "n_lanes": c.n_lanes,
+            "t_bins": c.t_bins,
+            "dt": c.dt,
+            "rates": {
+                "submit": c.submit_rate,
+                "cancel": c.cancel_rate,
+                "market": c.market_rate,
+            },
+            "hawkes": {
+                "excite_self": c.excite_self,
+                "excite_cross": c.excite_cross,
+                "excite_kind": c.excite_kind,
+                "decay": c.decay,
+                "branching_ratio": round(c.branching_ratio(), 6),
+            },
+            "zipf_a": c.zipf_a,
+            "offset_p": c.offset_p,
+            "ref_price": c.ref_price,
+        }
+
+
 _SVC_UUIDS = [f"u{i}" for i in range(256)]  # shared uuid dictionary
 
 
@@ -816,7 +915,31 @@ def service_main():
         return cols
 
     clean = run_stream("clean", clean_frame)
-    mixed_flow = _MixedFlow(np.random.default_rng(11), S)
+    # Headline traffic source: the hand-rolled reference-driver-shaped
+    # _MixedFlow (default), or the gome_tpu.sim Hawkes/Zipf generator
+    # (--flow sim / BENCH_FLOW=sim) — same column contract, but with
+    # clustered arrivals and book-coupled placement, and with its full
+    # provenance (seed + model params) recorded in the payload.
+    flow_kind = os.environ.get("BENCH_FLOW", "mixed")
+    if "--flow" in sys.argv:
+        flow_kind = sys.argv[sys.argv.index("--flow") + 1]
+    if flow_kind == "sim":
+        head_flow = _SimFlow(int(os.environ.get("SVC_SIM_SEED", 11)), S)
+        flow_info = head_flow.describe()
+        flow_label = "SIM Hawkes/Zipf"
+    elif flow_kind == "mixed":
+        head_flow = _MixedFlow(np.random.default_rng(11), S)
+        flow_info = {
+            "kind": "mixed",
+            "seed": 11,
+            "cancel_p": _MixedFlow.CANCEL_P,
+            "market_p": _MixedFlow.MARKET_P,
+            "same_frame_p": _MixedFlow.SAME_FRAME_P,
+            "zipf_a": 1.0,
+        }
+        flow_label = "MIXED"
+    else:
+        raise SystemExit(f"unknown --flow {flow_kind!r} (mixed|sim)")
     # The HEADLINE is the MEDIAN of SVC_REPEATS timed repeats (VERDICT r5
     # #1/#2): one repeat is a sample, not a claim — the best repeat stays
     # in the payload as a secondary field, next to the per-run rusage
@@ -824,7 +947,7 @@ def service_main():
     # histogram that say WHY the spread is what it is.
     REPEATS = int(os.environ.get("SVC_REPEATS", 5))
     mixed = run_stream(
-        "mixed", lambda: mixed_flow.frame(FRAME), repeats=REPEATS
+        flow_kind, lambda: head_flow.frame(FRAME), repeats=REPEATS
     )
     try:
         engine.save_geometry(geom_path)
@@ -834,12 +957,14 @@ def service_main():
     throughput = mixed["median_throughput"]
     result = {
         "metric": (
-            "service throughput gateway->matchOrder, MIXED stream "
-            f"(Zipf symbols, ~45% cancels incl. same-frame races, ~25% "
-            f"market orders, 256 uuids; everything after gRPC arrival), "
+            f"service throughput gateway->matchOrder, {flow_label} "
+            "stream "
+            f"(Zipf symbols, cancels + market orders, 256 uuids; "
+            f"everything after gRPC arrival), "
             f"{S} symbols, {FRAME}-order frames, int32 pallas, pipeline "
             f"depth {PIPE}; MEDIAN of {REPEATS} timed repeats"
         ),
+        "flow": flow_info,
         "value": round(throughput),
         "unit": "orders/sec",
         "vs_baseline": round(throughput / 1_000_000, 3),

@@ -1,0 +1,106 @@
+"""Market-simulator rollout — the gome_tpu.sim zero→aha demo.
+
+Runs a jitted `lax.scan` rollout of the gym-style environment (Hawkes/
+Zipf background flow over vmapped books, everything on device), then
+prints one JSON report: throughput (env steps/sec after warmup),
+activity (events and trades per step, overflow counters), and the
+statistical diagnostics that validate the flow model against its
+configuration (Zipf exponent fit, empirical vs configured Hawkes
+branching ratio, inter-window dispersion).
+
+    python examples/sim_rollout.py --steps 200 --lanes 64 --out SIM.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=200, help="rollout length")
+    ap.add_argument("--lanes", type=int, default=64, help="vmapped books")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="write the JSON report here")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from gome_tpu.engine.book import BookConfig
+    from gome_tpu.sim import (
+        EnvConfig, FlowConfig, env_reset, make_manifest, rollout,
+    )
+    from gome_tpu.sim import stats as sim_stats
+
+    config = EnvConfig(
+        flow=FlowConfig(n_lanes=args.lanes),
+        book=BookConfig(cap=32, max_fills=8, dtype=jnp.int32),
+    )
+    key = jax.random.PRNGKey(args.seed)
+
+    # Warm the compile off the clock, then time the steady-state scan.
+    state, _ = env_reset(config, key)
+    final, (rewards, info) = rollout(config, state, args.steps)
+    jax.block_until_ready(info.checksum)
+    state, _ = env_reset(config, key)
+    t0 = time.perf_counter()
+    final, (rewards, info) = rollout(config, state, args.steps)
+    jax.block_until_ready(info.checksum)
+    elapsed = time.perf_counter() - t0
+
+    events, trades, b_over, f_over = jax.device_get(
+        (info.events, info.trades, info.book_overflow, info.fill_overflow)
+    )
+
+    # Flow diagnostics on a fresh seeded sample (empty-book pricing —
+    # occurrence/type/lane statistics are book-independent).
+    n_grids = 400
+    sample = sim_stats.sample_grids(config.flow, args.seed, n_grids)
+    counts = sim_stats.symbol_counts(sample)
+    per_grid = sim_stats.events_per_grid(sample)
+    report = {
+        "metric": (
+            f"sim env rollout, {args.lanes} lanes x {args.steps} steps "
+            f"(jitted lax.scan, background Hawkes/Zipf flow)"
+        ),
+        "manifest": make_manifest(config, args.seed, args.steps),
+        "steps_per_sec": round(args.steps / elapsed, 2),
+        "orders_per_sec": round(int(events.sum()) / elapsed),
+        "events_per_step": round(float(events.mean()), 3),
+        "trades_per_step": round(float(trades.mean()), 3),
+        "book_overflow": int(b_over.sum()),
+        "fill_overflow": int(f_over.sum()),
+        "stats": {
+            "n_sample_grids": n_grids,
+            "zipf_a_configured": config.flow.zipf_a,
+            "zipf_a_fit": round(sim_stats.zipf_exponent(counts), 4),
+            "branching_configured": round(
+                config.flow.branching_ratio(), 4
+            ),
+            "branching_empirical": round(
+                sim_stats.empirical_branching_ratio(
+                    config.flow, int(per_grid.sum()), n_grids
+                ), 4
+            ),
+            "dispersion_index": round(
+                sim_stats.dispersion_index(per_grid), 4
+            ),
+        },
+    }
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

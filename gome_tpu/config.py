@@ -24,6 +24,7 @@ from typing import TYPE_CHECKING, Any, TypeVar
 
 if TYPE_CHECKING:
     from .engine.book import BookConfig
+    from .sim.env import EnvConfig
 
 import yaml
 
@@ -227,6 +228,91 @@ class OpsConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """The on-device market simulator (gome_tpu.sim): Hawkes/Zipf flow
+    parameters + environment geometry. New — the reference has no
+    simulator; bench.py's `--flow sim` and the RL environment read this
+    section. Scalars only so the block stays YAML-friendly; the derived
+    excitation matrix lives in sim.flow.FlowConfig."""
+
+    n_lanes: int = 256
+    t_bins: int = 32
+    dt: float = 0.02
+    submit_rate: float = 2.0
+    cancel_rate: float = 1.4
+    market_rate: float = 0.6
+    excite_self: float = 0.25
+    excite_cross: float = 0.10
+    excite_kind: float = 0.05
+    decay: float = 2.0
+    zipf_a: float = 1.1
+    offset_p: float = 0.35
+    max_offset: int = 200
+    ref_price: int = 100_000
+    ref_spread: int = 20
+    vol_max: int = 100
+    n_uids: int = 256
+    seed: int = 0
+    # Environment geometry (sim.env.EnvConfig).
+    cap: int = 16
+    max_fills: int = 4
+    dtype: str = "int32"
+    n_agent_ops: int = 2
+    obs_levels: int = 4
+
+    def __post_init__(self) -> None:
+        for name in ("n_lanes", "t_bins", "max_offset", "ref_price",
+                     "ref_spread", "vol_max", "n_uids", "cap", "max_fills",
+                     "n_agent_ops", "obs_levels"):
+            if getattr(self, name) <= 0:
+                raise ValueError(
+                    f"sim.{name} must be positive, got {getattr(self, name)}"
+                )
+        if self.dt <= 0 or self.decay <= 0:
+            raise ValueError("sim.dt and sim.decay must be positive")
+        if self.dtype not in ("int32", "int64"):
+            raise ValueError(
+                f"sim.dtype must be int32|int64, got {self.dtype}"
+            )
+        # The structured excitation matrix's Perron eigenvector is the
+        # all-ones vector, so the spectral radius has this closed form
+        # (sim.flow.FlowConfig re-checks the general eigenvalue bound).
+        br = self.excite_self + self.excite_cross + 4 * self.excite_kind
+        if br >= 1.0:
+            raise ValueError(
+                f"sim Hawkes parameters are unstable: branching ratio "
+                f"{br:.3f} >= 1 (lower excite_* or raise decay)"
+            )
+
+    def env_config(self) -> "EnvConfig":
+        """Build the sim.env.EnvConfig (imports jax — call lazily)."""
+        import jax.numpy as jnp
+
+        from .engine.book import BookConfig
+        from .sim.env import EnvConfig
+        from .sim.flow import FlowConfig
+
+        flow = FlowConfig(
+            n_lanes=self.n_lanes, t_bins=self.t_bins, dt=self.dt,
+            submit_rate=self.submit_rate, cancel_rate=self.cancel_rate,
+            market_rate=self.market_rate, excite_self=self.excite_self,
+            excite_cross=self.excite_cross, excite_kind=self.excite_kind,
+            decay=self.decay, zipf_a=self.zipf_a, offset_p=self.offset_p,
+            max_offset=self.max_offset, ref_price=self.ref_price,
+            ref_spread=self.ref_spread, vol_max=self.vol_max,
+            n_uids=self.n_uids,
+        )
+        book = BookConfig(
+            cap=self.cap, max_fills=self.max_fills,
+            dtype=jnp.int32 if self.dtype == "int32" else jnp.int64,
+        )
+        return EnvConfig(
+            flow=flow, book=book, n_agent_ops=self.n_agent_ops,
+            obs_levels=self.obs_levels,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     grpc: GrpcConfig = GrpcConfig()
     store: StoreConfig = StoreConfig()
@@ -234,6 +320,7 @@ class Config:
     engine: EngineConfig = EngineConfig()
     persist: PersistConfig = PersistConfig()
     ops: OpsConfig = OpsConfig()
+    sim: SimConfig = SimConfig()
 
 
 _C = TypeVar("_C")
@@ -283,11 +370,12 @@ def load_config(path: str | None = None) -> Config:
     ops_raw = dict(raw.get("ops", {}) or {})
     if ops_raw:
         ops_raw.setdefault("enabled", True)
+    sim_raw = dict(raw.get("sim", {}) or {})
     raw.pop("mysql", None)  # dead section, config.yaml.example:16-21
 
     known = {
         "grpc", "redis", "rabbitmq", "bus", "gomengine", "engine",
-        "persist", "ops",
+        "persist", "ops", "sim",
     }
     unknown = set(raw) - known
     if unknown:
@@ -300,4 +388,5 @@ def load_config(path: str | None = None) -> Config:
         engine=_build(EngineConfig, engine_raw, "engine"),
         persist=_build(PersistConfig, persist_raw, "persist"),
         ops=_build(OpsConfig, ops_raw, "ops"),
+        sim=_build(SimConfig, sim_raw, "sim"),
     )

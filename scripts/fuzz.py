@@ -187,13 +187,104 @@ def run_case(seed: int) -> str:
     )
 
 
+def run_sim_case(seed: int) -> str:
+    """Oracle parity on SIM-generated flow (gome_tpu.sim): a seeded
+    Hawkes/Zipf stream — clustered arrivals, Zipf-hot lanes, book-coupled
+    placement, and cancels targeting really-resting (oid, price) pairs —
+    exercises resting-queue depths and cancel patterns the uniform
+    stream above never reaches. The grid is linearized in (t, lane)
+    order (per-lane order preserved; lanes are independent) and fed to
+    both the oracle and a randomized adversarial engine geometry."""
+    import jax
+    import jax.numpy as jnp
+
+    from gome_tpu.engine import BatchEngine, BookConfig
+    from gome_tpu.oracle import OracleEngine
+    from gome_tpu.sim.env import EnvConfig, env_reset
+    from gome_tpu.sim.flow import FlowConfig
+    from gome_tpu.sim.replay import _record_step, orders_from_grid
+
+    rng = np.random.default_rng(seed)
+    flow = FlowConfig(
+        n_lanes=int(rng.choice([2, 4, 7])),
+        t_bins=int(rng.choice([32, 64])),
+        # Hotter-than-default excitation drives deeper bursts.
+        excite_self=float(rng.choice([0.25, 0.45])),
+        cancel_rate=float(rng.choice([0.8, 1.4, 2.0])),
+        market_rate=float(rng.choice([0.2, 0.8])),
+        offset_p=float(rng.choice([0.2, 0.5])),
+        vol_max=int(rng.choice([5, 60])),
+    )
+    # Generation-side geometry is generous (cap 64) so the stream's
+    # cancel targets come from a faithfully evolved book; the engine
+    # under test gets an ADVERSARIAL geometry below.
+    gen_cfg = EnvConfig(
+        flow=flow, book=BookConfig(cap=64, max_fills=8, dtype=jnp.int32)
+    )
+    n_grids = int(rng.choice([8, 20]))
+    state, _ = env_reset(gen_cfg, jax.random.PRNGKey(seed))
+    orders = []
+    for _ in range(n_grids):
+        state, bg_ops, _info = _record_step(gen_cfg, state)
+        orders.extend(orders_from_grid(jax.device_get(bg_ops)._asdict()))
+
+    oracle = OracleEngine()
+    expected = []
+    for o in orders:
+        expected.extend(oracle.process(o))
+
+    cap = int(rng.choice([4, 8, 16]))
+    max_fills = int(rng.choice([1, 2, 4]))
+    max_t = int(rng.choice([1, 3, 16]))
+    n_slots = int(rng.choice([1, 2, flow.n_lanes]))
+    dtype = jnp.int32 if rng.random() < 0.5 else jnp.int64
+    mode = str(rng.choice(["object", "columnar"]))
+    chunk = int(rng.choice([1, 17, 64]))
+    engine = BatchEngine(
+        BookConfig(cap=cap, max_fills=max_fills, dtype=dtype),
+        n_slots=n_slots, max_t=max_t,
+    )
+    got = []
+    for i in range(0, len(orders), chunk):
+        part = orders[i : i + chunk]
+        if mode == "columnar":
+            got.extend(engine.process_columnar(part).to_results())
+        else:
+            got.extend(engine.process(part))
+    desc = (
+        f"seed={seed} SIM lanes={flow.n_lanes} t_bins={flow.t_bins} "
+        f"grids={n_grids} n={len(orders)} cap={cap} K={max_fills} "
+        f"max_t={max_t} slots={n_slots} dtype={np.dtype(dtype).name} "
+        f"mode={mode} chunk={chunk}"
+    )
+    if got != expected:
+        first = next(
+            (j for j, (a, b) in enumerate(zip(got, expected)) if a != b),
+            min(len(got), len(expected)),
+        )
+        raise AssertionError(
+            f"DIVERGENCE [{desc}] events {len(got)} vs {len(expected)}, "
+            f"first mismatch at {first}:\n got: "
+            f"{got[first] if first < len(got) else '<none>'}\n exp: "
+            f"{expected[first] if first < len(expected) else '<none>'}"
+        )
+    engine.verify_books()
+    return (
+        f"OK [{desc}] events={len(got)} esc="
+        f"{engine.stats.cap_escalations}"
+        f"/{engine.stats.fill_record_escalations}"
+    )
+
+
 def main():
     configure(tpu="--tpu" in sys.argv)
-    args = [a for a in sys.argv[1:] if a != "--tpu"]
+    sim = "--sim" in sys.argv
+    args = [a for a in sys.argv[1:] if a not in ("--tpu", "--sim")]
     n = int(args[0]) if len(args) > 0 else 30
     seed0 = int(args[1]) if len(args) > 1 else 1000
+    case = run_sim_case if sim else run_case
     for s in range(seed0, seed0 + n):
-        print(run_case(s), flush=True)
+        print(case(s), flush=True)
     print(f"ALL {n} CASES PASSED")
     return 0
 

@@ -210,6 +210,36 @@ def test_engine_envelope_clean(dtype):
     assert check_engine_envelope(dtype) == []
 
 
+@pytest.mark.parametrize("dtype", ["int32", "int64"])
+def test_envelope_audits_sim_generator(dtype):
+    """The sim flow generator rides the same envelope audit: its entry
+    must be traced (allow_floats — the Hawkes intensities are f32 by
+    design) and `test_engine_envelope_clean` above proves it clean."""
+    from gome_tpu.analysis.envelope import traced_entries
+
+    contexts = [rec["context"] for rec in traced_entries(dtype)]
+    assert "sim/flow.py:gen_ops" in contexts
+
+
+def test_envelope_allow_floats_still_flags_strong_f64():
+    """The weak-f64 scalar exemption (jax library python literals, e.g.
+    inside jax.random under x64) must not exempt STRONG float64 values
+    under allow_floats."""
+    with jax.experimental.enable_x64():
+        strong = jax.make_jaxpr(
+            lambda v: v * 2.0
+        )(jnp.zeros((4,), jnp.float64))
+        weak_scalar = jax.make_jaxpr(
+            lambda k: jax.random.uniform(k, (), jnp.float32)
+        )(jax.random.PRNGKey(0))
+    assert "GL201" in rules_of(
+        check_jaxpr(strong, "int64", "fixture", allow_floats=True)
+    )
+    assert check_jaxpr(
+        weak_scalar, "int64", "fixture", allow_floats=True
+    ) == []
+
+
 # --- GL3xx recompile-hazard ----------------------------------------------
 
 

@@ -89,3 +89,29 @@ def test_validation_rejects_bad_values(tmp_path):
 def test_defaults_object():
     cfg = Config()
     assert cfg.engine.book_config().cap == 256
+
+
+def test_sim_section(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text(
+        """
+sim:
+  n_lanes: 32
+  zipf_a: 1.4
+  cap: 32
+  dtype: int64
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.sim.n_lanes == 32 and cfg.sim.zipf_a == 1.4
+    env_config = cfg.sim.env_config()
+    import jax.numpy as jnp
+
+    assert env_config.flow.n_lanes == 32
+    assert env_config.flow.zipf_a == 1.4
+    assert env_config.book.cap == 32
+    assert env_config.book.dtype == jnp.int64
+    # Hawkes stability gates at load time, before any jax import.
+    p.write_text("sim:\n  excite_self: 0.9\n  excite_cross: 0.3\n")
+    with pytest.raises(ValueError, match="unstable"):
+        load_config(str(p))

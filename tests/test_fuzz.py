@@ -21,3 +21,8 @@ _spec.loader.exec_module(_fuzz)
 @pytest.mark.parametrize("seed", range(500, 512))
 def test_fuzz_case(seed):
     print(_fuzz.run_case(seed))
+
+
+@pytest.mark.parametrize("seed", range(7000, 7004))
+def test_fuzz_sim_case(seed):
+    print(_fuzz.run_sim_case(seed))
