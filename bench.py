@@ -183,6 +183,25 @@ def _analytic_block(dtype_name):
         return None
 
 
+def _measured_block(dtype_name):
+    """MEASURED roofline metrics (gome_tpu.obs.profiler) folded next to
+    the analytic block in every BENCH payload: per-entry device time
+    from a bounded jax.profiler capture, achieved GFLOP/s / GB/s
+    (analytic work / measured time), and efficiency vs the machine
+    ceiling — so BENCH_*.json carries what the hardware DID next to
+    what XLA said it should do. BENCH_MEASURED=0 skips (captures cost
+    seconds); failures degrade to a stderr note, never a broken bench."""
+    if os.environ.get("BENCH_MEASURED", "1") == "0":
+        return None
+    try:
+        from gome_tpu.obs import profiler
+
+        return profiler.bench_measured(dtype_name)
+    except Exception as e:
+        print(f"# measured roofline unavailable: {e}", file=sys.stderr)
+        return None
+
+
 def _jit_cache_sizes(**fns):
     """{name: compiled-variant count} for the bench's own jits — the
     payload's compile count (how many distinct shapes the timed chain
@@ -991,6 +1010,9 @@ def service_main():
         # scripted-drill equivalent).
         analytic["compiled_frame_combos"] = len(engine.batch._seen_combos)
         result["analytic"] = analytic
+    measured = _measured_block("int32")
+    if measured is not None:
+        result["measured"] = measured
     print(json.dumps(result))
     print(
         f"# mixed vs clean: on-link {mixed['throughput'] / 1e3:.0f}K vs "
@@ -2117,6 +2139,9 @@ def main():
                 chain=timed_chain
             ).get("chain")
             result["analytic"] = analytic
+        measured = _measured_block(DTYPE)
+        if measured is not None:
+            result["measured"] = measured
         print(json.dumps(result))
         if os.environ.get("BENCH_VERBOSE"):
             shapes = [
@@ -2208,6 +2233,9 @@ def main():
             stepper=stepper
         ).get("stepper")
         result["analytic"] = analytic
+    measured = _measured_block(DTYPE)
+    if measured is not None:
+        result["measured"] = measured
     print(json.dumps(result))
     if os.environ.get("BENCH_VERBOSE"):
         print(

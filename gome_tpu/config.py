@@ -188,7 +188,16 @@ class OpsConfig:
     sampler is armed at boot and runs every `timeline_interval_s` seconds
     on a daemon thread while the service is started, keeping the last
     `timeline_keep` samples behind the /timeline endpoint and the
-    gome_timeline_* gauges."""
+    gome_timeline_* gauges.
+
+    profile/profile_keep configure the measured-roofline profiler
+    (gome_tpu.obs.profiler): with profile on, the PROFILER singleton is
+    armed at boot — per-shard dispatch telemetry records on the dense
+    mesh path, and the /profile endpoint captures a bounded
+    jax.profiler window on demand (first hit or ?refresh=1), keeping the
+    last `profile_keep` measured reports behind the gome_profile_*
+    gauges. Captures are seconds of work; they run only when asked,
+    never on the dispatch path."""
 
     host: str = "127.0.0.1"
     port: int = 9109
@@ -201,6 +210,8 @@ class OpsConfig:
     timeline: bool = True  # arm the host-side timeline sampler
     timeline_interval_s: float = 1.0  # sampling period (seconds)
     timeline_keep: int = 512  # timeline ring size (samples)
+    profile: bool = True  # arm the measured-roofline profiler
+    profile_keep: int = 8  # profiler report ring size (captures)
 
     def __post_init__(self) -> None:
         if self.trace_keep <= 0:
@@ -224,6 +235,11 @@ class OpsConfig:
             raise ValueError(
                 f"ops.timeline_keep must be positive, got "
                 f"{self.timeline_keep}"
+            )
+        if self.profile_keep <= 0:
+            raise ValueError(
+                f"ops.profile_keep must be positive, got "
+                f"{self.profile_keep}"
             )
 
 

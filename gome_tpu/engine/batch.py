@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.profiler import PROFILER
 from ..types import KERNELS, Action, MatchResult, Order
 from ..utils.metrics import REGISTRY
 from ..utils.trace import TRACER
@@ -82,6 +83,20 @@ REGISTRY.callback_gauge(
     "median dispatched-rows/live-lane across dense dispatches "
     "(ROADMAP open item 2 targets <= 2.0)",
     lambda: _rows_per_live_lane.quantile(0.5),
+)
+#: Per-shard skew companion (measured axis of the same open item): each
+#: dense MESH dispatch observes max-shard-live / mean-shard-live — 1.0 is
+#: perfectly balanced; the per-shard MAX bucketing makes dispatched rows
+#: (and so device time) scale with this ratio, not with total live work.
+_dense_shard_skew = REGISTRY.histogram(
+    "gome_dense_shard_skew",
+    "dense mesh dispatch max/mean live lanes per shard (1.0 = balanced)",
+    buckets=_ROWS_PER_LANE_BUCKETS,
+)
+REGISTRY.callback_gauge(
+    "gome_dense_shard_skew_p50",
+    "median per-shard live-lane skew across dense mesh dispatches",
+    lambda: _dense_shard_skew.quantile(0.5),
 )
 
 
@@ -935,6 +950,10 @@ class BatchEngine:
             rank = np.arange(len(live), dtype=np.int64) - starts[shard]
             rows_for_live = shard * r_s + rank
             lane_ids[rows_for_live] = live
+            # Per-shard telemetry (always-on histogram + the armed
+            # profiler's dispatch ring) from values already in hand.
+            _dense_shard_skew.observe(int(counts.max()) * d / len(live))
+            PROFILER.note_shard_dispatch(d, r_s, counts)
         row_of = np.empty(self.n_slots, np.int64)
         row_of[live] = rows_for_live
         # Skew telemetry: what row padding (pow2 bucket, grow-only floor,

@@ -21,6 +21,14 @@ cooperating pieces:
     allocations.
   * ``live`` — tagged ``jax.live_arrays()`` snapshots (per-subsystem
     HBM-residency gauges) and a steady-state leak detector.
+  * ``profiler`` — the MEASURED axis (ISSUE 9): programmatic
+    ``jax.profiler`` capture with a trace-event parser that joins
+    per-entry device time against the analytic flops/bytes — achieved
+    GFLOP/s, achieved GB/s, efficiency vs the roofline ceiling — plus
+    per-shard dispatched-rows/execution telemetry on the mesh path;
+    exported as ``gome_profile_*`` gauges and the ops ``/profile``
+    endpoint. ``PROFILER`` follows the same disabled-singleton hot-path
+    contract.
   * ``timeline`` — the TIME axis (ISSUE 6): a bounded host-side sampler
     recording RSS, getrusage deltas, live-buffer counts, compile totals,
     queue depth, and the geometry-manifest hash over a run; exported as
@@ -33,7 +41,9 @@ cooperating pieces:
 Import discipline: this ``__init__`` pulls in only ``compile_journal``
 and ``timeline`` (both dependency-free) so ``engine.frames`` can import
 the JOURNAL/TIMELINE singletons without a cycle; ``costmodel`` (which
-imports the engine) and ``live`` load lazily on first attribute access.
+imports the engine), ``live``, and ``profiler`` load lazily on first
+attribute access (engine.batch imports ``obs.profiler`` directly — the
+module keeps jax and the engine out of its import path on purpose).
 """
 
 from __future__ import annotations
@@ -50,11 +60,12 @@ __all__ = [
     "service_timeline",
     "costmodel",
     "live",
+    "profiler",
 ]
 
 
 def __getattr__(name):
-    if name in ("costmodel", "live"):
+    if name in ("costmodel", "live", "profiler"):
         import importlib
 
         mod = importlib.import_module(f".{name}", __name__)

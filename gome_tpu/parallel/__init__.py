@@ -1,6 +1,7 @@
 from .mesh import (
     make_mesh,
     shard_batch,
+    shard_execution_report,
     sharded_batch_step,
     symbol_sharding,
 )
@@ -9,6 +10,7 @@ from .router import ShardedEngine, ShardRouter, fnv1a, multihost_mesh
 __all__ = [
     "make_mesh",
     "shard_batch",
+    "shard_execution_report",
     "sharded_batch_step",
     "symbol_sharding",
     "ShardRouter",

@@ -236,6 +236,99 @@ def sharded_dense_step(
     )
 
 
+def shard_execution_report(
+    config: BookConfig,
+    mesh: Mesh,
+    books: BookState,
+    lane_ids,
+    ops: DeviceOp,
+    repeats: int = 3,
+) -> dict:
+    """MEASURED per-shard execution time for one dense mesh dispatch
+    (ISSUE 9): the skew tax as device seconds, not a host histogram.
+
+    ``shard_map`` executes every shard inside ONE dispatch, so the host
+    never sees per-shard time. This probe exploits the dense layout's
+    shard-locality (each row block [d*R_s, (d+1)*R_s) names only shard
+    d's lanes, zero collectives) to replay each shard's block as an
+    INDEPENDENT single-device call — same gather -> scan -> scatter
+    graph (engine.batch.dense_batch_step), same shapes, pinned to that
+    shard's own device — and times it best-of-``repeats``. Because the
+    per-shard row height R_s is the bucketed MAX of the live counts,
+    every shard pays the hottest shard's row count; ``exec_ms`` vs
+    ``live_lanes`` is that tax, measured.
+
+    Args mirror the dispatch: ``books`` the full [S] stack, ``lane_ids``
+    the [D*R_s] GLOBAL ids with sentinel ``S`` on padding rows (exactly
+    what ``BatchEngine._grid_geometry`` returns), ``ops`` the [D*R_s, T]
+    grid. An offline/ops-surface probe — never the dispatch path.
+    """
+    import time
+
+    import jax.numpy as jnp
+
+    from ..engine.batch import dense_batch_step
+
+    d = mesh.size
+    s = int(books.count.shape[0])
+    local = s // d
+    r_s = len(lane_ids) // d
+    devices = list(np.asarray(mesh.devices).flat)
+
+    ids_np = np.asarray(lane_ids)
+    shards = []
+    for j in range(d):
+        dev = devices[j]
+        blk = jax.tree.map(
+            lambda a, j=j: jax.device_put(a[j * local:(j + 1) * local], dev),
+            books,
+        )
+        ids_j = ids_np[j * r_s:(j + 1) * r_s]
+        # Localize exactly as the dispatch does (engine.batch._step):
+        # global lane % local IS the local index; sentinel -> `local`
+        # (out of range: gathered as zeros, dropped by the scatter).
+        ids_local = jax.device_put(
+            jnp.asarray(
+                np.where(ids_j >= s, local, ids_j % local), jnp.int32
+            ),
+            dev,
+        )
+        ops_j = jax.tree.map(
+            lambda a, j=j: jax.device_put(a[j * r_s:(j + 1) * r_s], dev), ops
+        )
+        jax.block_until_ready(dense_batch_step(config, blk, ids_local, ops_j))
+        best = float("inf")
+        for _ in range(max(1, repeats)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(
+                dense_batch_step(config, blk, ids_local, ops_j)
+            )
+            best = min(best, time.perf_counter() - t0)
+        live_j = int((ids_j < s).sum())
+        shards.append({
+            "shard": j,
+            "device": str(dev),
+            "rows": r_s,
+            "live_lanes": live_j,
+            "rows_per_live_lane": round(r_s / live_j, 4) if live_j else None,
+            "exec_ms": round(best * 1e3, 4),
+        })
+    times = [sh["exec_ms"] for sh in shards]
+    lives = [sh["live_lanes"] for sh in shards]
+    total_live = sum(lives) or 1
+    return {
+        "n_shards": d,
+        "rows_per_shard": r_s,
+        "dispatched_rows": d * r_s,
+        "live_lanes": sum(lives),
+        "shards": shards,
+        "exec_ms_max": max(times),
+        "exec_ms_mean": round(sum(times) / len(times), 4),
+        "live_skew": round(max(lives) * d / total_live, 4),
+        "rows_per_live_lane": round(d * r_s / total_live, 4),
+    }
+
+
 def global_fill_rate(outs) -> jax.Array:
     """Example cross-chip reduction: total fills in a batch (a psum over the
     sharded lane axis, handled by XLA from the jnp.sum)."""

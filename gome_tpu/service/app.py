@@ -139,6 +139,14 @@ class EngineService:
                     keep_n=self.config.ops.timeline_keep,
                 )
                 service_timeline(self)
+            if self.config.ops.profile:
+                # Arm the measured-roofline profiler (gome_tpu.obs.
+                # profiler): per-shard dispatch telemetry on the dense
+                # mesh path, bounded jax.profiler captures behind the
+                # ops /profile endpoint, gome_profile_* gauges.
+                from ..obs.profiler import PROFILER
+
+                PROFILER.install(keep_n=self.config.ops.profile_keep)
             self.ops = OpsServer(
                 self, host=self.config.ops.host, port=self.config.ops.port
             )

@@ -16,6 +16,7 @@ one stdlib ThreadingHTTPServer, no dependencies, curl-able:
     curl localhost:9109/trace > trace.json   # open in Perfetto
     curl localhost:9109/cost        # compiles + HBM + per-entry cost
     curl localhost:9109/timeline    # RSS/rusage/live-buffer time series
+    curl localhost:9109/profile     # measured roofline (capture on demand)
 
 Enabled by an `ops:` section in config.yaml (port, host) or by
 constructing OpsServer directly around any EngineService.
@@ -107,6 +108,27 @@ class OpsServer:
 
         return TIMELINE.as_dict()
 
+    def profile_payload(self, refresh: bool = False) -> dict:
+        """The /profile JSON document: the measured roofline
+        (gome_tpu.obs.profiler.PROFILER) — per-entry device time,
+        achieved GFLOP/s / GB/s, efficiency vs the analytic ceiling,
+        the Perfetto artifact path, and the per-shard dispatch
+        telemetry. Armed with no capture yet (or ``?refresh=1``) this
+        captures on demand — seconds of bounded work on the handler
+        thread, never the dispatch path; disabled it returns
+        ``{"enabled": false}``."""
+        from ..obs.profiler import PROFILER
+
+        dtype = "int32"
+        svc = self.service
+        if svc is not None:
+            import numpy as np
+
+            engine = getattr(svc, "engine", None)
+            if engine is not None:
+                dtype = np.dtype(engine.config.dtype).name
+        return PROFILER.payload(dtype=dtype, refresh=refresh)
+
     def start(self) -> "OpsServer":
         ops = self
 
@@ -154,6 +176,15 @@ class OpsServer:
                             ops.timeline_payload(), default=str
                         ).encode()
                         self._send(200, body, "application/json")
+                    elif self.path.split("?")[0] == "/profile":
+                        refresh = "refresh=1" in (
+                            self.path.split("?", 1)[1:] or [""]
+                        )[0]
+                        body = json.dumps(
+                            ops.profile_payload(refresh=refresh),
+                            default=str,
+                        ).encode()
+                        self._send(200, body, "application/json")
                     elif self.path.split("?")[0] == "/trace":
                         rec = ops.tracer.recorder
                         dump = (
@@ -179,7 +210,7 @@ class OpsServer:
         )
         self._thread.start()
         log.info("ops endpoint up on %s:%d (/metrics, /healthz, /trace, "
-                 "/cost, /timeline)", self.host, self.port)
+                 "/cost, /timeline, /profile)", self.host, self.port)
         return self
 
     def stop(self) -> None:

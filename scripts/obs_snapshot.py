@@ -14,10 +14,15 @@ in-process service stack and dump the operator surfaces to files —
                           series (RSS, rusage deltas, live buffers,
                           compile totals, geometry hash) — sampled
                           around the drill
+  <out_dir>/profile.json  the /profile payload: the MEASURED roofline
+                          (per-entry device time, achieved GFLOP/s,
+                          efficiency vs the analytic ceiling) from a
+                          bounded jax.profiler capture
+  <out_dir>/perfetto_trace.json.gz  the capture's raw Perfetto artifact
 
     python scripts/obs_snapshot.py [out_dir=obs-artifacts]
 
-CI (tier1.yml) uploads all three as build artifacts after the test run,
+CI (tier1.yml) uploads all of these as build artifacts after the test run,
 so every push records what the pipeline's observability surfaces actually
 look like — and a broken exposition/dump fails the step loudly.
 """
@@ -144,6 +149,36 @@ def main(out_dir: str = "obs-artifacts") -> int:
         json.dump(timeline, f, indent=1, default=str)
     assert "gome_timeline_rss_bytes" in metrics, "timeline gauges missing"
 
+    # The /profile payload (ops.profile armed the PROFILER at boot):
+    # a bounded measured-roofline capture over the canonical entries,
+    # plus the Perfetto artifact copied next to the JSON so CI's
+    # observability-snapshot upload carries the raw trace too.
+    import shutil
+
+    from gome_tpu.obs.profiler import PROFILER
+
+    profile = ops.profile_payload()
+    assert profile["enabled"], "ops.profile did not arm the profiler"
+    rep = profile["report"]
+    assert rep and rep["entries"], "profile report is empty"
+    measured = [
+        r for r in rep["entries"].values()
+        if "error" not in r and r.get("device_us_per_call", 0) > 0
+    ]
+    assert measured, f"no measured entries in profile report: {rep}"
+    with open(os.path.join(out_dir, "profile.json"), "w") as f:
+        json.dump(profile, f, indent=1, default=str)
+    perfetto_out = None
+    if rep.get("perfetto_trace") and os.path.exists(rep["perfetto_trace"]):
+        perfetto_out = os.path.join(out_dir, "perfetto_trace.json.gz")
+        shutil.copyfile(rep["perfetto_trace"], perfetto_out)
+    # The capture (re)binds the per-entry gauges; re-render so
+    # metrics.txt carries the gome_profile_* families.
+    metrics = REGISTRY.render()
+    assert "gome_profile_device_us" in metrics, "profile gauges missing"
+    with open(os.path.join(out_dir, "metrics.txt"), "w") as f:
+        f.write(metrics)
+
     journeys = {
         ev["args"]["trace_id"]
         for ev in dump["traceEvents"]
@@ -156,10 +191,14 @@ def main(out_dir: str = "obs-artifacts") -> int:
         f"{len(journeys)} journeys), {out_dir}/cost.json "
         f"({n_compiles} journaled compiles, "
         f"{len(cost['cost_model']['entries'])} cost-model entries), and "
-        f"{out_dir}/timeline.json ({len(timeline['samples'])} samples)"
+        f"{out_dir}/timeline.json ({len(timeline['samples'])} samples), "
+        f"{out_dir}/profile.json ({len(measured)} measured entries"
+        + (f", perfetto at {perfetto_out}" if perfetto_out else "")
+        + ")"
     )
     JOURNAL.disable()
     TIMELINE.disable()
+    PROFILER.disable()
     return 0
 
 

@@ -27,7 +27,12 @@ Gated metrics (lower is better for all):
     compile, gated at tolerance 0.
 
 Advisory (recorded in the report, NEVER gated): the drill's wall-clock
-orders/sec — the trend line humans read next to the gated metrics.
+orders/sec, plus the skew surface of ROADMAP open item 2 — the drill's
+measured ``gome_dispatched_rows_per_live_lane_p50`` and the
+deterministic D=8 Zipf per-shard skew model — printed every run and
+escalated to a WARNING line when a rows-per-live-lane p50 exceeds the
+2.0 placement target, so skew regressions are loud in CI before the
+placement fix lands.
 
 Toolchain drift: the XLA numbers are deterministic per jaxlib VERSION,
 not across versions. The baseline records the jax version it was taken
@@ -123,6 +128,53 @@ def frame_drill() -> dict:
     }
 
 
+#: ROADMAP open item 2's placement target: p50 dispatched-rows per live
+#: lane <= 2.0. Advisory-only until the placement fix lands — but LOUD
+#: (a WARNING line in the CI log) whenever a skew metric exceeds it.
+SKEW_TARGET = 2.0
+SKEW_METRICS = (
+    "gome_dispatched_rows_per_live_lane_p50",
+    "zipf_d8.rows_per_live_lane_p50",
+)
+
+
+def skew_advisory() -> dict:
+    """Per-shard skew surface (ROADMAP open item 2), ADVISORY only.
+
+    Two sources: the drill's own measured dense-dispatch histogram
+    (``gome_dispatched_rows_per_live_lane`` — frame_drill ran just
+    before, so its p50 reflects this exact scripted flow), and the
+    deterministic host-side D=8 Zipf packer model (the same per-shard
+    MAX bucketing math ``scripts/mesh_overhead.py --skew`` sweeps, fixed
+    seed) — so the 3.7x-class skew tax trends in every CI log before the
+    placement fix lands, without needing a mesh on the runner."""
+    import numpy as np
+
+    from gome_tpu.engine.batch import _next_pow2, _rows_per_live_lane
+
+    out = {
+        "gome_dispatched_rows_per_live_lane_p50": round(
+            _rows_per_live_lane.quantile(0.5), 4
+        ),
+    }
+    rng = np.random.default_rng(7)
+    s, d, draws = 1024, 8, 32
+    local = s // d
+    skews, rows_pll = [], []
+    for _ in range(draws):
+        lanes = np.unique(rng.zipf(1.1, size=256) % s)
+        counts = np.bincount(lanes // local, minlength=d)
+        r_s = max(8, _next_pow2(int(counts.max())))
+        live = len(lanes)
+        skews.append(int(counts.max()) * d / live)
+        rows_pll.append(min(r_s * d, s) / live)
+    out["zipf_d8.shard_skew_p50"] = round(float(np.median(skews)), 4)
+    out["zipf_d8.rows_per_live_lane_p50"] = round(
+        float(np.median(rows_pll)), 4
+    )
+    return out
+
+
 def collect() -> dict:
     """{"jax": version, "gated": {...}, "advisory": {...}}."""
     import jax
@@ -132,10 +184,12 @@ def collect() -> dict:
     gated = dict(costmodel.ratchet_metrics("int32"))
     drill = frame_drill()
     gated.update(drill["gated"])
+    advisory = drill["advisory"]
+    advisory.update(skew_advisory())
     return {
         "jax": jax.__version__,
         "gated": gated,
-        "advisory": drill["advisory"],
+        "advisory": advisory,
     }
 
 
@@ -251,6 +305,14 @@ def main(argv: list[str] | None = None) -> int:
         print(f"# {n}")
     for a, v in sorted(current["advisory"].items()):
         print(f"# advisory {a} = {v}")
+    for key in SKEW_METRICS:
+        v = current["advisory"].get(key)
+        if v is not None and v > SKEW_TARGET:
+            print(
+                f"# WARNING (advisory, non-gating): {key} = {v} exceeds "
+                f"the ROADMAP open-item-2 target {SKEW_TARGET} — "
+                "skew-aware placement still pending"
+            )
     if regressions:
         print(f"perf_ratchet: {len(regressions)} regressed metric(s):")
         for r in regressions:

@@ -7,6 +7,11 @@ Two parts:
     gome_tpu.obs.costmodel, replacing the hand-derived estimates this
     script used to carry. Printed first on every run; `--table` prints
     it alone (works on any backend, CPU included).
+  * the MEASURED roofline (`--measured`): a jax.profiler capture over
+    the same canonical entries, joined against the analytic table —
+    per-entry device time, achieved GFLOP/s / GB/s, and efficiency vs
+    the machine ceiling (gome_tpu.obs.profiler; any backend). `--table`
+    stays the analytic-only fallback.
   * the MEASURED sweep: times the compiled Pallas match kernel at the
     headline shape while sweeping the knobs that distinguish the
     candidate ceilings:
@@ -145,9 +150,58 @@ def analytic_table(dtype="int32"):
             )
 
 
+def measured_table(dtype="int32"):
+    """The MEASURED roofline joined against the analytic table
+    (gome_tpu.obs.profiler): a jax.profiler capture drives the same
+    canonical entries the analytic table reports, attributes per-entry
+    device time from the trace events, and divides the analytic work by
+    it — achieved GFLOP/s, achieved GB/s, and efficiency vs the
+    machine's roofline ceiling (min(peak_flops, intensity * peak_bw);
+    set GOME_PEAK_GFLOPS / GOME_PEAK_GBPS to override the one-shot
+    calibration). Works on any backend the profiler supports, CPU
+    included."""
+    from gome_tpu.obs.profiler import measured_entry_report
+
+    rep = measured_entry_report(
+        dtype, repeats=int(os.environ.get("ROOFLINE_PROFILE_REPEATS", 8))
+    )
+    pk = rep["peaks"]
+    print(
+        f"# measured roofline ({dtype}, {rep['platform']}; peaks "
+        f"{pk['peak_gflops']} GFLOP/s, {pk['peak_gbps']} GB/s, "
+        f"{pk['source']})"
+    )
+    print(
+        "# {:<26} {:>10} {:>12} {:>10} {:>12} {:>8}".format(
+            "entry", "dev_us", "ach_GFLOP/s", "ach_GB/s", "ceil_GFLOP/s",
+            "eff_%",
+        )
+    )
+    fmt = lambda v, p=3: "-" if v is None else f"{v:.{p}f}"
+    for name, r in rep["entries"].items():
+        if "error" in r:
+            print(f"# {name:<26} error: {r['error']}")
+            continue
+        print(
+            "# {:<26} {:>10} {:>12} {:>10} {:>12} {:>8}".format(
+                name,
+                fmt(r.get("device_us_per_call")),
+                fmt(r.get("achieved_gflops")),
+                fmt(r.get("achieved_gbps")),
+                fmt(r.get("roofline_ceiling_gflops")),
+                fmt(r.get("efficiency_pct"), 4),
+            )
+        )
+    print(f"# perfetto trace: {rep['perfetto_trace']}")
+
+
 def main():
-    analytic_table(os.environ.get("ROOFLINE_DTYPE", "int32"))
+    dtype = os.environ.get("ROOFLINE_DTYPE", "int32")
+    analytic_table(dtype)
     if "--table" in sys.argv:
+        return
+    if "--measured" in sys.argv:
+        measured_table(dtype)
         return
     # Headline point + cap sweep at fixed blocking.
     for cap in (64, 128, 256, 512):

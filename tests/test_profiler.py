@@ -1,0 +1,356 @@
+"""Measured roofline (gome_tpu.obs.profiler): trace-event attribution,
+the profiler capture + report join, the /profile endpoint, the per-shard
+dispatch telemetry, and the committed MULTICHIP_r06 curve — the ISSUE 9
+surface."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+
+import pytest
+
+from gome_tpu.obs import costmodel, profiler
+from gome_tpu.obs.compile_journal import JOURNAL
+from gome_tpu.obs.profiler import (
+    ANNOTATION_PREFIX,
+    PROFILER,
+    parse_trace_events,
+)
+from gome_tpu.obs.timeline import TIMELINE
+
+
+@pytest.fixture(autouse=True)
+def _profiler_disabled():
+    """Every test leaves the process-global profiler disabled (the
+    hot-path default other tests assume)."""
+    yield
+    PROFILER.disable()
+
+
+# --- the pure trace-event parser ------------------------------------------
+
+
+def _golden_events():
+    """Hand-written Chrome trace-event list exercising every attribution
+    rule at once: nested XLA ops (union, not sum), a thread-duplicated
+    runtime symbol (``::`` exclusion), a ``$``-prefixed Python event, a
+    host-infra prefix, a window-straddling op (clipping), a device-process
+    event (counts by construction), and a bare-label window (TraceMe
+    pipelines that strip the prefix at a separator)."""
+    return [
+        # process metadata
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        # annotation window: gome_profile/lane_scan over [1000, 2000)
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 1000, "dur": 1000,
+         "name": ANNOTATION_PREFIX + "lane_scan"},
+        # nested compute ops: `call` CONTAINS the reduce-window it calls
+        # — the union must count this region once (400), not 770
+        {"ph": "X", "pid": 1, "tid": 2, "ts": 1100, "dur": 400,
+         "name": "call"},
+        {"ph": "X", "pid": 1, "tid": 2, "ts": 1120, "dur": 370,
+         "name": "reduce-window.2.clone"},
+        # runtime plumbing, duplicated across threads: excluded by `::`
+        {"ph": "X", "pid": 1, "tid": 2, "ts": 1100, "dur": 800,
+         "name": "TfrtCpuExecutable::Execute"},
+        {"ph": "X", "pid": 1, "tid": 3, "ts": 1100, "dur": 800,
+         "name": "TfrtCpuExecutable::Execute"},
+        # Python-originated and host-infra events: excluded
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 1150, "dur": 100,
+         "name": "$RunBlockHostUntilReady"},
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 1050, "dur": 900,
+         "name": "PjitFunction(lane_scan)"},
+        # a second disjoint op (+200) and one straddling the window end
+        # (300 long, only 100 inside)
+        {"ph": "X", "pid": 1, "tid": 2, "ts": 1600, "dur": 200,
+         "name": "fusion.1"},
+        {"ph": "X", "pid": 1, "tid": 2, "ts": 1900, "dur": 300,
+         "name": "fusion.2"},
+        # device-process event: compute by construction even though the
+        # name would fail the host heuristic; overlaps `call`, so the
+        # TOTAL union is unchanged while by_device gains a row
+        {"ph": "X", "pid": 2, "tid": 1, "ts": 1200, "dur": 100,
+         "name": "while.5"},
+        # zero-duration noise: dropped
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 1400, "dur": 0,
+         "name": "fusion.3"},
+        # bare-label window (prefix stripped upstream) + one op inside
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 3000, "dur": 500,
+         "name": "compact_accum"},
+        {"ph": "X", "pid": 1, "tid": 2, "ts": 3100, "dur": 200,
+         "name": "fusion.9"},
+    ]
+
+
+def test_parse_golden_trace_interval_union():
+    out = parse_trace_events(
+        _golden_events(), ["lane_scan", "compact_accum", "missing"]
+    )
+    row = out["lane_scan"]
+    assert row["windows"] == 1
+    assert row["wall_us"] == 1000.0
+    # call(400) ∪ nested reduce-window ∪ fusion.1(200) ∪ clipped
+    # fusion.2(100); the device event overlaps `call` so it adds nothing
+    assert row["device_us"] == 700.0
+    assert row["by_device"] == {"/host:CPU": 700.0, "/device:TPU:0": 100.0}
+    # call, reduce-window, fusion.1, fusion.2, while.5 — the excluded
+    # runtime/Python/infra/zero-dur events never land in the hit list
+    assert row["events"] == 5
+    assert row["top_op"] == "call"
+
+    bare = out["compact_accum"]
+    assert bare["windows"] == 1
+    assert bare["wall_us"] == 500.0
+    assert bare["device_us"] == 200.0
+    assert bare["top_op"] == "fusion.9"
+
+    none = out["missing"]
+    assert none["windows"] == 0
+    assert none["device_us"] == 0.0
+    assert none["top_op"] is None
+
+
+def test_parse_merges_split_annotation_windows():
+    """Two windows for one label: wall sums, ops clip to the union of
+    both — an op in the gap between windows contributes nothing."""
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 1, "ts": 100, "dur": 100,
+         "name": ANNOTATION_PREFIX + "batch_step"},
+        {"ph": "X", "pid": 1, "ts": 400, "dur": 100,
+         "name": ANNOTATION_PREFIX + "batch_step"},
+        {"ph": "X", "pid": 1, "ts": 150, "dur": 20, "name": "fusion.0"},
+        {"ph": "X", "pid": 1, "ts": 250, "dur": 50, "name": "fusion.1"},
+        {"ph": "X", "pid": 1, "ts": 430, "dur": 40, "name": "fusion.2"},
+    ]
+    row = parse_trace_events(events, ["batch_step"])["batch_step"]
+    assert row["windows"] == 2
+    assert row["wall_us"] == 200.0
+    assert row["device_us"] == 60.0  # fusion.1 sits in the gap
+    assert row["events"] == 2
+
+
+# --- the measured report (one real capture) -------------------------------
+
+
+def test_measured_report_joins_and_respects_peaks():
+    PROFILER.install(keep_n=2)
+    rep = PROFILER.capture_report("int32", repeats=2)
+    assert rep["platform"] == "cpu"
+    assert rep["peaks"]["peak_gflops"] > 0
+    assert rep["peaks"]["peak_gbps"] > 0
+    rows = [r for r in rep["entries"].values() if "error" not in r]
+    assert len(rows) >= 3, rep["entries"]
+    assert set(rep["entries"]) <= set(costmodel.RATCHET_ENTRIES)
+    for row in rows:
+        assert row["device_us_per_call"] > 0
+        assert row["flops"] and row["bytes_accessed"]
+        # measured rates come from ANALYTIC work over MEASURED time; a
+        # tiny integer scan sits orders of magnitude under the machine
+        # ceiling, so even generous calibration slack never trips this
+        assert row["achieved_gflops"] <= rep["peaks"]["peak_gflops"] * 1.5
+        assert row["achieved_gbps"] <= rep["peaks"]["peak_gbps"] * 1.5
+        assert 0 < row["efficiency_pct"] <= 150.0
+    # the capture left a loadable Perfetto artifact next to the report
+    assert rep["perfetto_trace"] and os.path.exists(rep["perfetto_trace"])
+    assert rep["run_dir"] and os.path.isdir(rep["run_dir"])
+
+    # the capture rode the ring and (re)bound the per-entry gauges
+    assert PROFILER.enabled
+    assert PROFILER.last_report() is rep
+    payload = PROFILER.payload(dtype="int32")  # reuses the ring, no capture
+    assert payload["enabled"] and payload["captures"] >= 1
+    assert payload["report"] is rep
+    from gome_tpu.utils.metrics import REGISTRY
+
+    metrics = REGISTRY.render()
+    assert "gome_profile_captures_total" in metrics
+    assert "gome_profile_device_us" in metrics
+    assert 'entry="' in metrics
+
+    # bench.py's compact measured block derives from the same machinery
+    block = profiler.bench_measured("int32", repeats=2)
+    assert block["dtype"] == "int32"
+    assert block["entries"]
+    for row in block["entries"].values():
+        assert set(row) == {"device_us_per_call", "achieved_gflops",
+                            "achieved_gbps", "efficiency_pct"}
+
+
+# --- /profile over HTTP ---------------------------------------------------
+
+
+def test_profile_endpoint_http_validity():
+    from gome_tpu.config import Config, EngineConfig, OpsConfig
+    from gome_tpu.engine import frames
+    from gome_tpu.service.app import EngineService
+
+    cfg = Config(
+        engine=EngineConfig(cap=16, max_fills=4, n_slots=4, max_t=4,
+                            dtype="int32"),
+        ops=OpsConfig(port=0, enabled=True),
+    )
+    svc = EngineService(cfg)
+    assert PROFILER.enabled  # ops.profile armed the profiler at boot
+    # one fast-path frame so the capture runs against a warmed engine —
+    # the "real frame drill" of the acceptance criteria
+    rng = np.random.default_rng(3)
+    n = 16
+    frames.apply_frame_fast(svc.engine.batch, dict(
+        n=n,
+        action=np.ones(n, np.int64),
+        side=rng.integers(0, 2, n).astype(np.int64),
+        kind=np.zeros(n, np.int64),
+        price=rng.integers(99_000, 101_000, n).astype(np.int64),
+        volume=rng.integers(1, 10, n).astype(np.int64),
+        symbols=[f"s{i}" for i in range(4)],
+        symbol_idx=rng.integers(0, 4, n).astype(np.int64),
+        uuids=["u0"],
+        uuid_idx=np.zeros(n, np.int64),
+        oids=np.char.add("p", np.arange(n).astype("U6")).astype("S"),
+    ))
+    svc.ops.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.ops.port}/profile", timeout=120
+        ) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            doc = json.loads(r.read().decode())
+        assert doc["enabled"] is True
+        assert doc["captures"] >= 1
+        rep = doc["report"]
+        assert rep and rep["entries"], "measured report empty over HTTP"
+        measured = [
+            row for row in rep["entries"].values()
+            if "error" not in row and row["device_us_per_call"] > 0
+        ]
+        assert measured, rep["entries"]
+        for row in measured:
+            assert row["achieved_gflops"] > 0
+    finally:
+        svc.ops.stop()
+        JOURNAL.disable()
+        TIMELINE.disable()
+        PROFILER.disable()
+
+
+# --- disabled contract: no-op + zero hot-path allocations -----------------
+
+
+def test_disabled_profiler_is_inert():
+    PROFILER.disable()
+    assert not PROFILER.enabled
+    assert PROFILER.shard_report() == {"enabled": False}
+    payload = PROFILER.payload()
+    assert payload == {"enabled": False, "captures": 0, "report": None,
+                       "shards": {"enabled": False}}
+
+
+def test_disabled_shard_hook_allocates_nothing():
+    """Same contract as TRACER/JOURNAL/TIMELINE: the dispatch-path hook
+    costs one attribute check and ZERO allocations when disabled."""
+    PROFILER.disable()
+    counts = np.array([3, 1])
+
+    def drill(n):
+        i = 0
+        while i < n:
+            PROFILER.note_shard_dispatch(2, 8, counts)
+            i += 1
+
+    drill(64)  # warm any lazy caches
+    before = sys.getallocatedblocks()
+    drill(200)
+    after = sys.getallocatedblocks()
+    assert after - before <= 2, f"hot-path hook allocated {after - before}"
+
+
+# --- per-shard telemetry on a 2-device mesh -------------------------------
+
+
+def test_shard_telemetry_on_two_device_mesh():
+    from gome_tpu.engine import BatchEngine, BookConfig
+    from gome_tpu.engine.batch import _nop_grid
+    from gome_tpu.engine.book import DeviceOp
+    from gome_tpu.parallel import make_mesh, shard_execution_report
+
+    cfg = BookConfig(cap=8, max_fills=4)
+    mesh = make_mesh(2)
+    eng = BatchEngine(cfg, n_slots=64, max_t=4, mesh=mesh)
+    PROFILER.install(keep_n=2)
+    # 3 live lanes on shard 0, 1 on shard 1 -> r_s buckets to the max (8)
+    live = np.array([0, 1, 2, 35], dtype=np.int64)
+    use_dense, n_rows, lane_ids, _ = eng._grid_geometry(live)
+    assert use_dense and n_rows == 16
+
+    rep = PROFILER.shard_report()
+    assert rep["enabled"] and rep["dispatches"] == 1
+    last = rep["last"]
+    assert last["n_shards"] == 2
+    assert last["rows_per_shard"] == 8
+    assert last["dispatched_rows"] == 16
+    assert last["live_per_shard"] == [3, 1]
+    assert last["skew"] == pytest.approx(1.5)  # 3 * 2 / 4
+    assert last["rows_per_live_lane"] == pytest.approx(4.0)
+    assert rep["skew_p50"] == pytest.approx(1.5)
+
+    # measured per-shard replay: both shards pay the SAME bucketed row
+    # height (the skew tax) and report positive execution time
+    ops = DeviceOp(**_nop_grid(cfg, n_rows, 4))
+    per_shard = shard_execution_report(
+        cfg, mesh, eng.books, lane_ids, ops, repeats=1
+    )
+    assert per_shard["n_shards"] == 2
+    assert per_shard["rows_per_shard"] == 8
+    assert [sh["live_lanes"] for sh in per_shard["shards"]] == [3, 1]
+    assert all(sh["rows"] == 8 for sh in per_shard["shards"])
+    assert all(sh["exec_ms"] > 0 for sh in per_shard["shards"])
+    assert per_shard["live_skew"] == pytest.approx(1.5)
+    assert per_shard["exec_ms_max"] >= per_shard["exec_ms_mean"]
+
+
+# --- the committed multi-chip curve ---------------------------------------
+
+
+def test_multichip_r06_artifact_pins_the_measured_curve():
+    """MULTICHIP_r06.json is a COMMITTED artifact (scripts/mesh_overhead.py
+    --curve): the first measured D=1/2/4/8 throughput curve with per-shard
+    skew. This pin keeps the committed numbers structurally honest."""
+    path = os.path.join(os.path.dirname(__file__), "..", "MULTICHIP_r06.json")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["platform"] == "cpu"
+    curve = doc["curve"]
+    assert [p["devices"] for p in curve] == [1, 2, 4, 8]
+    for p in curve:
+        assert p["live_orders_per_sec"] > 0
+        assert p["step_ms"] > 0
+        assert p["dispatched_rows"] >= p["live_lanes"]
+        assert len(p["live_per_shard"]) == p["devices"]
+        assert sum(p["live_per_shard"]) == p["live_lanes"]
+        assert p["shard_skew"] >= 1.0
+        if p["devices"] > 1:
+            per_shard = p["per_shard"]
+            assert per_shard["n_shards"] == p["devices"]
+            assert len(per_shard["shards"]) == p["devices"]
+            assert all(sh["exec_ms"] > 0 for sh in per_shard["shards"])
+            assert per_shard["live_skew"] == pytest.approx(
+                p["shard_skew"], rel=1e-3
+            )
+    # skew grows with shard count under a Zipf flow — the measured
+    # restatement of ROADMAP open item 2
+    assert curve[-1]["shard_skew"] > 2.0
+    # the embedded measured-roofline block is non-empty
+    prof = doc["profile"]
+    assert prof["entries"]
+    assert any(
+        (row.get("device_us_per_call") or 0) > 0
+        for row in prof["entries"].values()
+    )
