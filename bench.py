@@ -202,6 +202,26 @@ def _measured_block(dtype_name):
         return None
 
 
+def _host_block():
+    """Host-CPU admit metrics (gome_tpu.obs.hostprof) folded into the
+    mixed-stream SERVICE payload next to the analytic/measured blocks:
+    measured gateway admit ns/order + achievable orders/sec/core and
+    the per-stage split from the sampling profiler's deterministic
+    drill — so BENCH_SERVICE_*.json carries the host trajectory (the
+    front-door bottleneck, ROADMAP open item 1) from r06 onward.
+    BENCH_HOST=0 skips; failures degrade to a stderr note, never a
+    broken bench."""
+    if os.environ.get("BENCH_HOST", "1") == "0":
+        return None
+    try:
+        from gome_tpu.obs import hostprof
+
+        return hostprof.bench_host()
+    except Exception as e:
+        print(f"# host admit drill unavailable: {e}", file=sys.stderr)
+        return None
+
+
 def _jit_cache_sizes(**fns):
     """{name: compiled-variant count} for the bench's own jits — the
     payload's compile count (how many distinct shapes the timed chain
@@ -1013,6 +1033,9 @@ def service_main():
     measured = _measured_block("int32")
     if measured is not None:
         result["measured"] = measured
+    host = _host_block()
+    if host is not None:
+        result["host"] = host
     print(json.dumps(result))
     print(
         f"# mixed vs clean: on-link {mixed['throughput'] / 1e3:.0f}K vs "

@@ -197,7 +197,16 @@ class OpsConfig:
     jax.profiler window on demand (first hit or ?refresh=1), keeping the
     last `profile_keep` measured reports behind the gome_profile_*
     gauges. Captures are seconds of work; they run only when asked,
-    never on the dispatch path."""
+    never on the dispatch path.
+
+    hostprof/hostprof_hz/hostprof_keep configure the host-CPU sampling
+    profiler (gome_tpu.obs.hostprof): with hostprof on, the HOSTPROF
+    singleton is armed at boot and its thread-mode wall sampler runs
+    while the service is started, sampling every hostprof_hz-th of a
+    second with a `hostprof_keep`-deep raw-stack ring, behind the
+    /hostprof endpoint and the gome_hostprof_* gauges. The admit drill
+    (the measured per-stage gateway breakdown) runs only on demand
+    (?drill=1), never on the serving path."""
 
     host: str = "127.0.0.1"
     port: int = 9109
@@ -212,6 +221,9 @@ class OpsConfig:
     timeline_keep: int = 512  # timeline ring size (samples)
     profile: bool = True  # arm the measured-roofline profiler
     profile_keep: int = 8  # profiler report ring size (captures)
+    hostprof: bool = True  # arm the host-CPU sampling profiler
+    hostprof_hz: float = 67.0  # live wall-sampler cadence (Hz)
+    hostprof_keep: int = 4096  # raw-stack ring size (samples)
 
     def __post_init__(self) -> None:
         if self.trace_keep <= 0:
@@ -240,6 +252,16 @@ class OpsConfig:
             raise ValueError(
                 f"ops.profile_keep must be positive, got "
                 f"{self.profile_keep}"
+            )
+        if self.hostprof_hz <= 0:
+            raise ValueError(
+                f"ops.hostprof_hz must be positive, got "
+                f"{self.hostprof_hz}"
+            )
+        if self.hostprof_keep <= 0:
+            raise ValueError(
+                f"ops.hostprof_keep must be positive, got "
+                f"{self.hostprof_keep}"
             )
 
 

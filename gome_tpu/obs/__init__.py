@@ -34,21 +34,31 @@ cooperating pieces:
     queue depth, and the geometry-manifest hash over a run; exported as
     the ops ``/timeline`` endpoint + ``gome_timeline_*`` gauges and
     consumed by ``scripts/soak.py`` for the steady-state verdicts.
+  * ``hostprof`` — the HOST-CPU axis (ISSUE 10): an in-process sampling
+    profiler (SIGPROF/setitimer with a daemon-thread fallback) whose
+    samples join against the tracer stage taxonomy — measured ns/order
+    per host stage, the gateway admit split function-by-function, and
+    the host-vs-device roofline (``HOSTPROF_r01.json``); exported as
+    the ops ``/hostprof`` endpoint + ``gome_hostprof_*`` gauges.
+    ``HOSTPROF`` follows the same disabled-singleton hot-path contract
+    (the gateway calls ``note_admit`` per accepted order).
   * ``scripts/perf_ratchet.py`` — gates the deterministic analytic
     metrics (flops/order, bytes/order, peak HBM, compile count) against
     the committed ``PERF_BASELINE.json`` in CI.
 
-Import discipline: this ``__init__`` pulls in only ``compile_journal``
-and ``timeline`` (both dependency-free) so ``engine.frames`` can import
-the JOURNAL/TIMELINE singletons without a cycle; ``costmodel`` (which
-imports the engine), ``live``, and ``profiler`` load lazily on first
-attribute access (engine.batch imports ``obs.profiler`` directly — the
-module keeps jax and the engine out of its import path on purpose).
+Import discipline: this ``__init__`` pulls in only ``compile_journal``,
+``timeline``, and ``hostprof`` (all dependency-free) so ``engine.frames``
+/ ``service.gateway`` can import the JOURNAL/TIMELINE/HOSTPROF
+singletons without a cycle; ``costmodel`` (which imports the engine),
+``live``, and ``profiler`` load lazily on first attribute access
+(engine.batch imports ``obs.profiler`` directly — the module keeps jax
+and the engine out of its import path on purpose).
 """
 
 from __future__ import annotations
 
 from .compile_journal import JOURNAL, CompileJournal, frame_combo_detail
+from .hostprof import HOSTPROF, HostSampler
 from .timeline import TIMELINE, TimelineSampler, service_timeline
 
 __all__ = [
@@ -58,6 +68,9 @@ __all__ = [
     "TIMELINE",
     "TimelineSampler",
     "service_timeline",
+    "HOSTPROF",
+    "HostSampler",
+    "hostprof",
     "costmodel",
     "live",
     "profiler",

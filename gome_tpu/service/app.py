@@ -147,6 +147,18 @@ class EngineService:
                 from ..obs.profiler import PROFILER
 
                 PROFILER.install(keep_n=self.config.ops.profile_keep)
+            if self.config.ops.hostprof:
+                # Arm the host-CPU sampling profiler (gome_tpu.obs.
+                # hostprof): gateway note_admit hook live, thread-mode
+                # wall sampler behind the ops /hostprof endpoint and the
+                # gome_hostprof_* gauges. The sampler thread runs only
+                # while the service is start()ed.
+                from ..obs.hostprof import HOSTPROF
+
+                HOSTPROF.install(
+                    hz=self.config.ops.hostprof_hz,
+                    keep_n=self.config.ops.hostprof_keep,
+                )
             self.ops = OpsServer(
                 self, host=self.config.ops.host, port=self.config.ops.port
             )
@@ -165,6 +177,10 @@ class EngineService:
                 from ..obs.timeline import TIMELINE
 
                 TIMELINE.start()
+            if self.config.ops.hostprof:
+                from ..obs.hostprof import HOSTPROF
+
+                HOSTPROF.start()
         return self
 
     def stop(self):
@@ -179,6 +195,10 @@ class EngineService:
                 from ..obs.timeline import TIMELINE
 
                 TIMELINE.stop()
+            if self.config.ops.hostprof:
+                from ..obs.hostprof import HOSTPROF
+
+                HOSTPROF.stop()
 
     def wait(self):
         if self._server is not None:

@@ -26,6 +26,7 @@ from ..api.service import add_order_servicer
 from ..bus import QueueBus, encode_order
 from ..config import Config
 from ..fixed import scale
+from ..obs.hostprof import HOSTPROF
 from ..types import Action, Order, OrderType, Side
 from ..utils.logging import get_logger
 from ..utils.trace import TRACER
@@ -168,6 +169,7 @@ class OrderGateway:
                 code=CODE_REJECT, message=f"rejected: {e}"
             )
         # main.go:49: unconditional success; matching outcome arrives async.
+        HOSTPROF.note_admit()  # disabled: one attribute check, no allocs
         return pb.OrderResponse(code=0, message="order accepted")
 
     def DeleteOrder(self, request: pb.OrderRequest, context) -> pb.OrderResponse:
@@ -190,6 +192,7 @@ class OrderGateway:
             return pb.OrderResponse(
                 code=CODE_REJECT, message=f"rejected: {e}"
             )
+        HOSTPROF.note_admit()
         return pb.OrderResponse(code=0, message="cancel accepted")
 
     def _apply_entries(self, entries) -> pb.OrderBatchResponse:
@@ -239,6 +242,8 @@ class OrderGateway:
                 break
             accepted += 1
         resp.accepted = accepted
+        if accepted:
+            HOSTPROF.note_admit(accepted)  # one locked add per batch
         return resp
 
     def DoOrderBatch(

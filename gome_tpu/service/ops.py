@@ -17,6 +17,9 @@ one stdlib ThreadingHTTPServer, no dependencies, curl-able:
     curl localhost:9109/cost        # compiles + HBM + per-entry cost
     curl localhost:9109/timeline    # RSS/rusage/live-buffer time series
     curl localhost:9109/profile     # measured roofline (capture on demand)
+    curl localhost:9109/hostprof    # host-CPU stage attribution (?drill=1
+                                    # runs the admit drill; ?format=collapsed
+                                    # dumps flamegraph-ready stacks)
 
 Enabled by an `ops:` section in config.yaml (port, host) or by
 constructing OpsServer directly around any EngineService.
@@ -129,6 +132,18 @@ class OpsServer:
                 dtype = np.dtype(engine.config.dtype).name
         return PROFILER.payload(dtype=dtype, refresh=refresh)
 
+    def hostprof_payload(self, run_drill: bool = False) -> dict:
+        """The /hostprof JSON document: the host-CPU sampling profiler
+        (gome_tpu.obs.hostprof.HOSTPROF) — the live wall-profile stage
+        join plus the last admit-drill report (measured per-stage
+        gateway ns/order and achievable orders/sec/core). ``?drill=1``
+        runs the deterministic admit drill on demand — sub-second of
+        bounded work on the handler thread, never the serving path;
+        disabled it returns ``{"enabled": false}``."""
+        from ..obs.hostprof import HOSTPROF
+
+        return HOSTPROF.payload(run_drill=run_drill)
+
     def start(self) -> "OpsServer":
         ops = self
 
@@ -185,6 +200,23 @@ class OpsServer:
                             default=str,
                         ).encode()
                         self._send(200, body, "application/json")
+                    elif self.path.split("?")[0] == "/hostprof":
+                        query = (self.path.split("?", 1)[1:] or [""])[0]
+                        if "format=collapsed" in query:
+                            from ..obs.hostprof import HOSTPROF
+
+                            self._send(
+                                200, HOSTPROF.collapsed().encode(),
+                                "text/plain",
+                            )
+                            return
+                        body = json.dumps(
+                            ops.hostprof_payload(
+                                run_drill="drill=1" in query
+                            ),
+                            default=str,
+                        ).encode()
+                        self._send(200, body, "application/json")
                     elif self.path.split("?")[0] == "/trace":
                         rec = ops.tracer.recorder
                         dump = (
@@ -210,7 +242,8 @@ class OpsServer:
         )
         self._thread.start()
         log.info("ops endpoint up on %s:%d (/metrics, /healthz, /trace, "
-                 "/cost, /timeline, /profile)", self.host, self.port)
+                 "/cost, /timeline, /profile, /hostprof)",
+                 self.host, self.port)
         return self
 
     def stop(self) -> None:

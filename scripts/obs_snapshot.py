@@ -19,6 +19,12 @@ in-process service stack and dump the operator surfaces to files —
                           efficiency vs the analytic ceiling) from a
                           bounded jax.profiler capture
   <out_dir>/perfetto_trace.json.gz  the capture's raw Perfetto artifact
+  <out_dir>/hostprof.json the /hostprof payload: the host-CPU sampling
+                          profiler's admit-drill report (per-stage
+                          gateway ns/order, achievable orders/sec/core)
+                          plus the live wall-profile join
+  <out_dir>/hostprof_collapsed.txt  the collapsed-stack (flamegraph
+                          text) dump behind /hostprof?format=collapsed
 
     python scripts/obs_snapshot.py [out_dir=obs-artifacts]
 
@@ -172,10 +178,32 @@ def main(out_dir: str = "obs-artifacts") -> int:
     if rep.get("perfetto_trace") and os.path.exists(rep["perfetto_trace"]):
         perfetto_out = os.path.join(out_dir, "perfetto_trace.json.gz")
         shutil.copyfile(rep["perfetto_trace"], perfetto_out)
+    # The /hostprof payload (ops.hostprof armed HOSTPROF at boot): the
+    # service is not start()ed here so the live wall sampler never ran —
+    # the admit drill (run_drill, same as ?drill=1) supplies the
+    # measured per-stage gateway breakdown, and the collapsed-stack
+    # artifact rides next to the JSON.
+    hostprof_doc = ops.hostprof_payload(run_drill=True)
+    assert hostprof_doc["enabled"], "ops.hostprof did not arm HOSTPROF"
+    drill = hostprof_doc["drill"]
+    assert drill and drill["sampler"]["samples"] > 0, (
+        f"hostprof drill captured no samples: {hostprof_doc}"
+    )
+    assert drill["stages"], "hostprof drill attributed no stages"
+    with open(os.path.join(out_dir, "hostprof.json"), "w") as f:
+        json.dump(hostprof_doc, f, indent=1, default=str)
+    from gome_tpu.obs.hostprof import HOSTPROF
+
+    collapsed = HOSTPROF.collapsed()
+    assert ";" in collapsed, f"no collapsed stacks: {collapsed[:200]}"
+    with open(os.path.join(out_dir, "hostprof_collapsed.txt"), "w") as f:
+        f.write(collapsed)
+
     # The capture (re)binds the per-entry gauges; re-render so
-    # metrics.txt carries the gome_profile_* families.
+    # metrics.txt carries the gome_profile_* / gome_hostprof_* families.
     metrics = REGISTRY.render()
     assert "gome_profile_device_us" in metrics, "profile gauges missing"
+    assert "gome_hostprof_" in metrics, "hostprof gauges missing"
     with open(os.path.join(out_dir, "metrics.txt"), "w") as f:
         f.write(metrics)
 
@@ -194,11 +222,14 @@ def main(out_dir: str = "obs-artifacts") -> int:
         f"{out_dir}/timeline.json ({len(timeline['samples'])} samples), "
         f"{out_dir}/profile.json ({len(measured)} measured entries"
         + (f", perfetto at {perfetto_out}" if perfetto_out else "")
-        + ")"
+        + f"), {out_dir}/hostprof.json "
+        f"({drill['sampler']['samples']} host samples, "
+        f"{drill['admit_ns_per_order']} ns/order admit)"
     )
     JOURNAL.disable()
     TIMELINE.disable()
     PROFILER.disable()
+    HOSTPROF.disable()
     return 0
 
 

@@ -32,7 +32,12 @@ measured ``gome_dispatched_rows_per_live_lane_p50`` and the
 deterministic D=8 Zipf per-shard skew model — printed every run and
 escalated to a WARNING line when a rows-per-live-lane p50 exceeds the
 2.0 placement target, so skew regressions are loud in CI before the
-placement fix lands.
+placement fix lands. Also advisory (wall-clock, so never gateable on
+shared runners): the gateway admit surface of ROADMAP open item 1 —
+measured admit ns/order and achievable orders/sec/core from
+``obs.hostprof``'s deterministic seeded admit drill, printed as a loud
+ADVISORY line every run so the front-door bottleneck (and the columnar
+rework's eventual win) trends in every CI log.
 
 Toolchain drift: the XLA numbers are deterministic per jaxlib VERSION,
 not across versions. The baseline records the jax version it was taken
@@ -175,6 +180,33 @@ def skew_advisory() -> dict:
     return out
 
 
+def gateway_advisory() -> dict:
+    """Gateway admit surface (ROADMAP open item 1), ADVISORY only —
+    wall-clock numbers can never gate on shared runners.
+
+    Sourced from obs.hostprof's deterministic seeded admit drill (fixed
+    request stream through a real OrderGateway on an in-process bus; the
+    SAMPLING is what varies run to run, the measured ns/order is plain
+    wall/N). A drill failure degrades to an error row, never a broken
+    ratchet."""
+    try:
+        from gome_tpu.obs import hostprof
+
+        drill = hostprof.gateway_drill(
+            n_orders=8192, seed=11, min_samples=64, max_rounds=2
+        )
+        return {
+            "gateway.admit_ns_per_order": drill["admit_ns_per_order"],
+            "gateway.admit_orders_per_sec_per_core": (
+                drill["admit_orders_per_sec_per_core"]
+            ),
+            "gateway.hostprof_samples": drill["sampler"]["samples"],
+            "gateway.hostprof_coverage_pct": drill["coverage_pct"],
+        }
+    except Exception as exc:  # pragma: no cover - env-specific
+        return {"gateway.advisory_error": f"{type(exc).__name__}: {exc}"}
+
+
 def collect() -> dict:
     """{"jax": version, "gated": {...}, "advisory": {...}}."""
     import jax
@@ -186,6 +218,7 @@ def collect() -> dict:
     gated.update(drill["gated"])
     advisory = drill["advisory"]
     advisory.update(skew_advisory())
+    advisory.update(gateway_advisory())
     return {
         "jax": jax.__version__,
         "gated": gated,
@@ -305,6 +338,17 @@ def main(argv: list[str] | None = None) -> int:
         print(f"# {n}")
     for a, v in sorted(current["advisory"].items()):
         print(f"# advisory {a} = {v}")
+    admit_ns = current["advisory"].get("gateway.admit_ns_per_order")
+    admit_rate = current["advisory"].get(
+        "gateway.admit_orders_per_sec_per_core"
+    )
+    if admit_ns is not None:
+        print(
+            f"# ADVISORY (never gated, wall-clock): gateway admit path "
+            f"measured at {admit_ns} ns/order -> {admit_rate} "
+            "orders/sec/core — the front-door bottleneck of ROADMAP "
+            "open item 1 (host roofline: HOSTPROF_r01.json)"
+        )
     for key in SKEW_METRICS:
         v = current["advisory"].get(key)
         if v is not None and v > SKEW_TARGET:
