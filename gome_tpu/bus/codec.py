@@ -87,13 +87,20 @@ def encode_match_result(mr: MatchResult) -> bytes:
         "MatchNode": _encode_snapshot(mr.match_node),
         "MatchVolume": mr.match_volume,
     }
+    if mr.seq is not None:
+        # Matchfeed sequence number (ISSUE 11 exactly-once). Extension
+        # field like Kind/Trace: absent on reference-shaped messages,
+        # ignored by a reference decoder.
+        body["Seq"] = mr.seq
     return json.dumps(body, separators=(",", ":")).encode()
 
 
 def decode_match_result(body: bytes) -> MatchResult:
     d = json.loads(body)
+    seq = d.get("Seq")
     return MatchResult(
         node=_decode_snapshot(d["Node"]),
         match_node=_decode_snapshot(d["MatchNode"]),
         match_volume=int(d["MatchVolume"]),
+        seq=None if seq is None else int(seq),
     )

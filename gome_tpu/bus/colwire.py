@@ -45,6 +45,11 @@ ORDER_MAGIC_V1 = b"GCO1"  # decode-compat: pre-cache dict-column layout
 #: GCO2 — zero wire overhead on the hot path.
 ORDER_MAGIC_TRACED = b"GCO3"
 EVENT_MAGIC = b"GCE1"
+#: GCE1 + one u64 base sequence number after the count: event i in the
+#: frame is matchfeed seq ``seq0 + i`` (exactly-once across restarts —
+#: ISSUE 11). Emitted only when the publisher stamps seqs, so legacy
+#: traffic stays byte-identical GCE1 (the GCO3 migration story again).
+EVENT_MAGIC_SEQ = b"GCE2"
 
 # Order columns: (name, dtype) fixed-width part.
 _ORDER_NUM = (
@@ -328,14 +333,23 @@ def _read_id_table(buf: memoryview, off: int):
     return [s.decode() for s in arr.tolist()], off
 
 
-def encode_event_frame(batch) -> bytes:
+def encode_event_frame(batch, seq0: int | None = None) -> bytes:
     """EventBatch -> one EVENT frame. Only the id-table entries the batch
     references are shipped (remapped to frame-local ids), so frame size
     tracks the batch, not the process-lifetime interners. All column and
-    table packing is vectorized — no per-event or per-string Python."""
+    table packing is vectorized — no per-event or per-string Python.
+
+    With ``seq0`` (defaults to the batch's own stamp) the frame is GCE2:
+    a u64 base seq follows the count and event i is seq ``seq0 + i``.
+    Without one it stays byte-identical GCE1."""
     c = batch.columns
     n = len(batch)
-    parts = [EVENT_MAGIC, struct.pack("<I", n)]
+    if seq0 is None:
+        seq0 = getattr(batch, "seq0", None)
+    if seq0 is None:
+        parts = [EVENT_MAGIC, struct.pack("<I", n)]
+    else:
+        parts = [EVENT_MAGIC_SEQ, struct.pack("<IQ", n, seq0)]
     local_cols: dict[str, np.ndarray] = {}
     tables = []
     for table, cols in (
@@ -399,10 +413,16 @@ def decode_event_frame(payload: bytes):
     from ..engine.events import EventBatch
 
     buf = memoryview(payload)
-    if bytes(buf[:4]) != EVENT_MAGIC:
+    magic = bytes(buf[:4])
+    seq0: int | None = None
+    if magic == EVENT_MAGIC:
+        (n,) = struct.unpack_from("<I", buf, 4)
+        off = 8
+    elif magic == EVENT_MAGIC_SEQ:
+        n, seq0 = struct.unpack_from("<IQ", buf, 4)
+        off = 16
+    else:
         raise ValueError("not an EVENT frame")
-    (n,) = struct.unpack_from("<I", buf, 4)
-    off = 8
     cols: dict = {}
     for name, dt in _EVENT_NUM:
         cols[name] = np.frombuffer(buf, dt, n, off).astype(
@@ -415,5 +435,6 @@ def decode_event_frame(payload: bytes):
     oids, off = _read_id_table(buf, off)
     cols["arrival"] = np.arange(n, dtype=np.int64)
     return EventBatch(
-        columns=cols, symbols=symbols, oid_table=oids, uid_table=uids
+        columns=cols, symbols=symbols, oid_table=oids, uid_table=uids,
+        seq0=seq0,
     )

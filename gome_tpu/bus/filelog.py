@@ -16,12 +16,21 @@ role the raw Redis book plays in the reference (§5.4).
 from __future__ import annotations
 
 import os
+import re
 import struct
 import threading
 
+from ..utils.faults import FAULTS
 from .base import Message, Queue, _Waitable
 
 _LEN = struct.Struct(">I")
+
+# Committed-offset sidecar parse: accept any leading decimal run. A torn
+# write of "1234" can leave "12" — and any prefix of a decimal string is
+# numerically <= the full value, so the digit prefix IS the last valid
+# committed prefix (commits only move forward; re-delivery is safe,
+# losing acknowledged work is not).
+_OFF_RE = re.compile(rb"\s*(\d+)")
 
 
 class FileQueue(_Waitable, Queue):
@@ -59,18 +68,35 @@ class FileQueue(_Waitable, Queue):
                 f.truncate(valid_end)
 
     def _read_committed(self) -> int:
+        """Parse the sidecar, surviving torn/empty/garbage contents.
+
+        Fallback order: digit prefix of whatever is there (see _OFF_RE),
+        else 0 (full replay from the start). Either way the result is
+        clamped to [0, len(positions)] — a sidecar ahead of a truncated
+        log must not make read_from index past the end.
+        """
         try:
-            with open(self._off_path) as f:
-                return int(f.read().strip() or 0)
-        except FileNotFoundError:
+            with open(self._off_path, "rb") as f:
+                m = _OFF_RE.match(f.read(64))
+        except OSError:
             return 0
+        committed = int(m.group(1)) if m else 0
+        return min(committed, len(self._positions))
 
     # -- Queue interface -----------------------------------------------------
     def publish(self, body: bytes) -> int:
         with self._lock:
+            record = _LEN.pack(len(body)) + body
+            cut = FAULTS.fire("filelog.append")
+            if cut:
+                # Torn append: persist a strict prefix of the record and
+                # die. _scan_existing truncates it away on the next open.
+                self._f.write(record[: cut % len(record)])
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                FAULTS.hard_exit()
             pos = self._f.tell()
-            self._f.write(_LEN.pack(len(body)))
-            self._f.write(body)
+            self._f.write(record)
             self._f.flush()
             if self._fsync:
                 os.fsync(self._f.fileno())
@@ -138,6 +164,15 @@ class FileQueue(_Waitable, Queue):
             del self._positions[offset:]
 
     def _write_offset(self, offset: int) -> None:
+        cut = FAULTS.fire("filelog.offset")
+        if cut:
+            # Torn sidecar: a truncated decimal written straight to the
+            # final path (simulating a filesystem that tore the replace),
+            # then die. _read_committed's digit-prefix parse recovers.
+            text = str(offset)
+            with open(self._off_path, "w") as f:
+                f.write(text[: cut % (len(text) + 1)])
+            FAULTS.hard_exit()
         tmp = self._off_path + ".tmp"
         with open(tmp, "w") as f:
             f.write(str(offset))

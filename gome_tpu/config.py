@@ -25,6 +25,7 @@ from typing import TYPE_CHECKING, Any, TypeVar
 if TYPE_CHECKING:
     from .engine.book import BookConfig
     from .sim.env import EnvConfig
+    from .utils.faults import FaultPlan
 
 import yaml
 
@@ -351,6 +352,42 @@ class SimConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FaultsConfig:
+    """Deterministic fault injection (utils.faults) — chaos/test tooling
+    only; production configs omit the section and the FAULTS singleton
+    stays a zero-allocation no-op. A `faults:` block arms the registry at
+    EngineService boot so a fault *plan* (seed + schedule) travels with
+    the config as a reproducible artifact. Give either `plan` (path to a
+    FaultPlan JSON written by scripts/chaos.py) or `points` (inline list
+    of FaultSpec dicts, YAML-friendly), not both."""
+
+    enabled: bool = False
+    seed: int = 0
+    plan: str = ""  # path to a FaultPlan JSON file
+    # Inline FaultSpec dicts straight from YAML; validated when the plan
+    # is built (FaultSpec.from_dict), not here, so config loading stays
+    # import-light.
+    points: Any = ()
+
+    def __post_init__(self) -> None:
+        if self.plan and self.points:
+            raise ValueError(
+                "faults: give plan (file) or points (inline), not both"
+            )
+
+    def fault_plan(self) -> "FaultPlan":
+        """Materialize the schedule (reads the plan file when given)."""
+        from .utils.faults import FaultPlan
+
+        if self.plan:
+            with open(self.plan) as f:
+                return FaultPlan.from_json(f.read())
+        return FaultPlan.from_dict(
+            {"seed": self.seed, "faults": list(self.points)}
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     grpc: GrpcConfig = GrpcConfig()
     store: StoreConfig = StoreConfig()
@@ -359,6 +396,7 @@ class Config:
     persist: PersistConfig = PersistConfig()
     ops: OpsConfig = OpsConfig()
     sim: SimConfig = SimConfig()
+    faults: FaultsConfig = FaultsConfig()
 
 
 _C = TypeVar("_C")
@@ -409,11 +447,14 @@ def load_config(path: str | None = None) -> Config:
     if ops_raw:
         ops_raw.setdefault("enabled", True)
     sim_raw = dict(raw.get("sim", {}) or {})
+    faults_raw = dict(raw.get("faults", {}) or {})
+    if faults_raw:
+        faults_raw.setdefault("enabled", True)
     raw.pop("mysql", None)  # dead section, config.yaml.example:16-21
 
     known = {
         "grpc", "redis", "rabbitmq", "bus", "gomengine", "engine",
-        "persist", "ops", "sim",
+        "persist", "ops", "sim", "faults",
     }
     unknown = set(raw) - known
     if unknown:
@@ -427,4 +468,5 @@ def load_config(path: str | None = None) -> Config:
         persist=_build(PersistConfig, persist_raw, "persist"),
         ops=_build(OpsConfig, ops_raw, "ops"),
         sim=_build(SimConfig, sim_raw, "sim"),
+        faults=_build(FaultsConfig, faults_raw, "faults"),
     )

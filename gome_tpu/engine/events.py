@@ -59,6 +59,9 @@ class EventBatch:
     symbols: list[str]  # lane -> symbol string
     oid_table: list[str]  # interner id -> oid string ("" at 0)
     uid_table: list[str]
+    # Matchfeed base sequence number: event i is seq ``seq0 + i``. None on
+    # unstamped batches (pre-ISSUE-11 wire compat; GCE1 frames).
+    seq0: int | None = None
 
     def __len__(self) -> int:
         return len(self.columns["arrival"])
@@ -69,7 +72,9 @@ class EventBatch:
         c = self.columns
         out: list[MatchResult] = []
         oid_t, uid_t, syms = self.oid_table, self.uid_table, self.symbols
+        seq0 = self.seq0
         for i in range(len(self)):
+            seq = None if seq0 is None else seq0 + i
             symbol = syms[c["symbol_id"][i]]
             side = Side(int(c["taker_side"][i]))
             kind = (
@@ -88,7 +93,9 @@ class EventBatch:
             )
             if c["is_cancel"][i]:
                 out.append(
-                    MatchResult(node=taker, match_node=taker, match_volume=0)
+                    MatchResult(
+                        node=taker, match_node=taker, match_volume=0, seq=seq
+                    )
                 )
                 continue
             maker = snapshot_of(
@@ -106,18 +113,26 @@ class EventBatch:
                     node=taker,
                     match_node=maker,
                     match_volume=int(c["match_volume"][i]),
+                    seq=seq,
                 )
             )
         return out
 
-    def to_json_lines(self) -> list[bytes]:
+    def to_json_lines(self, seq0: int | None = None) -> list[bytes]:
         """Wire-shape serialization straight from columns — byte-identical
         to bus.codec.encode_match_result for every event. Only the ids this
         batch references are JSON-escaped (the interner tables grow without
         bound over a process lifetime; escaping whole tables per batch would
-        be quadratic on the consumer hot path)."""
+        be quadratic on the consumer hot path).
+
+        With ``seq0`` (defaults to the batch's own stamp) each line gains a
+        trailing ``"Seq"`` extension field — absent on unstamped batches so
+        reference-shaped output is unchanged, ignored by a reference
+        decoder otherwise (the Trace-field precedent, bus.codec)."""
         import json
 
+        if seq0 is None:
+            seq0 = self.seq0
         c = self.columns
 
         def esc(table, *id_cols):
@@ -142,21 +157,22 @@ class EventBatch:
                 m_side = 1 - side
                 m_price = int(c["fill_price"][i])
                 m_vol = int(c["maker_volume"][i])
-            lines.append(
-                (
-                    '{"Node":{"Uuid":%s,"Oid":%s,"Symbol":%s,'
-                    '"Transaction":%d,"Price":%d,"Volume":%d},'
-                    '"MatchNode":{"Uuid":%s,"Oid":%s,"Symbol":%s,'
-                    '"Transaction":%d,"Price":%d,"Volume":%d},'
-                    '"MatchVolume":%d}'
-                    % (
-                        t_u, t_o, symbol, side,
-                        int(c["taker_price"][i]), int(c["taker_volume"][i]),
-                        m_u, m_o, symbol, m_side, m_price, m_vol,
-                        int(c["match_volume"][i]),
-                    )
-                ).encode()
+            body = (
+                '{"Node":{"Uuid":%s,"Oid":%s,"Symbol":%s,'
+                '"Transaction":%d,"Price":%d,"Volume":%d},'
+                '"MatchNode":{"Uuid":%s,"Oid":%s,"Symbol":%s,'
+                '"Transaction":%d,"Price":%d,"Volume":%d},'
+                '"MatchVolume":%d'
+                % (
+                    t_u, t_o, symbol, side,
+                    int(c["taker_price"][i]), int(c["taker_volume"][i]),
+                    m_u, m_o, symbol, m_side, m_price, m_vol,
+                    int(c["match_volume"][i]),
+                )
             )
+            if seq0 is not None:
+                body += ',"Seq":%d' % (seq0 + i)
+            lines.append((body + "}").encode())
         return lines
 
 

@@ -327,6 +327,8 @@ def service_timeline(service, sampler: TimelineSampler | None = None):
       queue    — doOrder backlog (published minus committed offsets)
       batcher  — FrameBatcher buffered/spill/degraded state (only when
                  the service's gateway runs one)
+      persist  — snapshot cadence + recovery state (only when the service
+                 runs a Persister)
     """
     tl = sampler or TIMELINE
     engine = getattr(service, "engine", service)
@@ -394,4 +396,10 @@ def service_timeline(service, sampler: TimelineSampler | None = None):
             }
 
         tl.register("batcher", batcher_probe)
+
+    persist = getattr(service, "persist", None)
+    if persist is not None and hasattr(persist, "probe"):
+        # Snapshot cadence + recovery state (persist.snapshot.Persister) —
+        # soak verdicts can now see whether snapshots kept their cadence.
+        tl.register("persist", persist.probe)
     return tl

@@ -126,7 +126,9 @@ class ShardedEngine:
 
 class _ResultsBatch:
     """list[MatchResult] with the minimal EventBatch surface the consumer's
-    publish path uses (len, to_results, to_json_lines)."""
+    publish path uses (len, to_results, to_json_lines, seq0)."""
+
+    seq0 = None  # unstamped; the consumer passes seq0 explicitly
 
     def __init__(self, results):
         self._results = results
@@ -137,10 +139,17 @@ class _ResultsBatch:
     def to_results(self):
         return list(self._results)
 
-    def to_json_lines(self):
+    def to_json_lines(self, seq0=None):
+        import dataclasses
+
         from ..bus import encode_match_result
 
-        return [encode_match_result(r) for r in self._results]
+        if seq0 is None:
+            return [encode_match_result(r) for r in self._results]
+        return [
+            encode_match_result(dataclasses.replace(r, seq=seq0 + i))
+            for i, r in enumerate(self._results)
+        ]
 
 
 def multihost_mesh(n_local: int | None = None):

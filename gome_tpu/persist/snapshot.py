@@ -6,9 +6,11 @@ import json
 import os
 import shutil
 import tempfile
+import time
 
 import numpy as np
 
+from ..utils.faults import FAULTS
 from ..utils.logging import get_logger
 
 log = get_logger("persist")
@@ -57,6 +59,16 @@ class SnapshotStore:
                 json.dump(manifest, f)
                 f.flush()
                 os.fsync(f.fileno())
+            cut = FAULTS.fire("snapshot.rename")
+            if cut:
+                # Torn publish: truncate the manifest inside tmp, complete
+                # the rename anyway, and die — load_latest must skip the
+                # unreadable snapshot and fall back to the previous one.
+                mpath = os.path.join(tmp, _MANIFEST)
+                with open(mpath, "rb+") as f:
+                    f.truncate(cut % os.path.getsize(mpath))
+                os.rename(tmp, final)
+                FAULTS.hard_exit()
             os.rename(tmp, final)
             # fsync the parent dir so the rename itself survives power loss
             dirfd = os.open(self.dir, os.O_RDONLY)
@@ -104,12 +116,22 @@ class Persister:
         self._batches = 0
         self.engine = None  # MatchEngine
         self.bus = None
+        self.consumer = None  # OrderConsumer (for matchfeed seq recovery)
         self.snapshots_taken = 0
         self.restored = False
+        # Durability telemetry (/durability payload, gome_* gauges, the
+        # timeline probe). Written from the consumer thread only.
+        self.last_snapshot_unix = 0.0
+        self.last_snapshot_bytes = 0
+        self.last_restore = "never"  # never | none | replayed | restored
+        self.last_recovery_seconds = 0.0
+        self.wal_replay_frames = 0
 
-    def attach(self, engine, bus) -> None:
+    def attach(self, engine, bus, consumer=None) -> None:
         self.engine = engine
         self.bus = bus
+        if consumer is not None:
+            self.consumer = consumer
 
     # -- called by OrderConsumer after each committed batch ------------------
     def on_batch(self, n_orders: int, n_events: int) -> None:
@@ -142,11 +164,23 @@ class Persister:
             "version": 1,
             "order_committed": self.bus.order_queue.committed(),
             "match_end": self.bus.match_queue.end_offset(),
+            # Matchfeed seq at the cut: every event below match_end carries
+            # a seq below this (exactly-once suppression after restore).
+            "match_seq": (
+                self.consumer.match_seq if self.consumer is not None else 0
+            ),
             "pre_pool": pre_pool,
             **{k: v for k, v in state.items() if k != "books"},
         }
         path = self.store.save(manifest, state["books"])
         self.snapshots_taken += 1
+        self.last_snapshot_unix = time.time()
+        try:
+            self.last_snapshot_bytes = sum(
+                os.path.getsize(os.path.join(path, n)) for n in os.listdir(path)
+            )
+        except OSError:
+            pass
         log.info(
             "snapshot %s (orders<%d, matches<%d)",
             os.path.basename(path),
@@ -161,6 +195,7 @@ class Persister:
         deterministically, regenerating the truncated match-queue tail
         exactly (see package docstring). Returns True if a snapshot was
         applied."""
+        t0 = time.monotonic()
         loaded = self.store.load_latest()
         oq = self.bus.order_queue
         mq = self.bus.match_queue
@@ -176,23 +211,53 @@ class Persister:
             # holds.
             self.engine.pre_pool.clear()
             self.engine.pre_pool.update(tuple(k) for k in manifest["pre_pool"])
-            oq.rollback(manifest["order_committed"])
+            # The snapshot is the authority on the cut. Normally the cut is
+            # at/below the committed offset (rollback); after a TORN
+            # .offset sidecar the recovered committed offset can sit BELOW
+            # the cut (FileQueue falls back to a conservative digit
+            # prefix) — the snapshot proves orders below the cut are fully
+            # applied, so seek forward instead of replaying them onto
+            # restored books (found by scripts/chaos.py's torn-sidecar
+            # schedule).
+            cut = manifest["order_committed"]
+            if cut <= oq.committed():
+                oq.rollback(cut)
+            else:
+                oq.commit(cut)
             # The feed may have committed past the cut before the crash;
             # replay regenerates byte-identical events, so rewind its cursor
             # and drop the stale tail.
             mq.rollback(min(mq.committed(), manifest["match_end"]))
             mq.truncate_to(manifest["match_end"])
+            if self.consumer is not None:
+                # Replay regenerates the truncated match tail with the
+                # SAME seqs it had pre-crash (exactly-once across restarts).
+                self.consumer.reset_seq(int(manifest.get("match_seq", 0)))
             self.restored = True
-        elif oq.committed() > 0:
+        elif oq.committed() > 0 or mq.end_offset() > 0:
             # Durable order log but no snapshot yet (crash before the first
             # cadence tick): the engine is fresh/empty, so the only
             # consistent cut is offset 0 — rewind and replay the ENTIRE log;
             # the truncated match queue is regenerated deterministically.
+            # The mq conditions cover a crash BEFORE the first order-queue
+            # commit but AFTER a match publish (the at-least-once window at
+            # offset 0): without truncation the replay would re-publish
+            # those events as queue-level duplicates (found by
+            # scripts/chaos.py's first-frame kill).
             oq.rollback(0)
             mq.rollback(0)
             mq.truncate_to(0)
+            if self.consumer is not None:
+                self.consumer.reset_seq(0)
         replayed = self._reconstruct_marks(
             cut=oq.committed(), consumed_to=consumed_to
+        )
+        self.wal_replay_frames = replayed
+        self.last_recovery_seconds = time.monotonic() - t0
+        self.last_restore = (
+            "restored"
+            if loaded is not None
+            else ("replayed" if replayed else "none")
         )
         if loaded is not None or replayed:
             log.info(
@@ -274,3 +339,52 @@ class Persister:
         # of 256K-order frames would otherwise take minutes to re-mark).
         self.engine.pre_pool.update(remark)
         return len(tail)
+
+    # -- observability -------------------------------------------------------
+    def snapshot_age_seconds(self) -> float:
+        """Seconds since the last snapshot; -1 before the first one."""
+        if not self.last_snapshot_unix:
+            return -1.0
+        return max(0.0, time.time() - self.last_snapshot_unix)
+
+    def export_metrics(self, registry=None) -> None:
+        """Register the durability gauges (callback gauges: values are read
+        from this Persister at scrape time; re-registering rebinds)."""
+        if registry is None:
+            from ..utils.metrics import REGISTRY as registry  # noqa: N811
+        registry.callback_gauge(
+            "gome_snapshot_age_seconds",
+            "Seconds since the last snapshot (-1 before the first)",
+            self.snapshot_age_seconds,
+        )
+        registry.callback_gauge(
+            "gome_snapshot_bytes",
+            "On-disk size of the last snapshot",
+            lambda: float(self.last_snapshot_bytes),
+        )
+        registry.callback_gauge(
+            "gome_snapshots_taken_total",
+            "Snapshots taken by this process",
+            lambda: float(self.snapshots_taken),
+        )
+        registry.callback_gauge(
+            "gome_recovery_seconds",
+            "Duration of the last restore_latest (restore + mark rebuild)",
+            lambda: self.last_recovery_seconds,
+        )
+        registry.callback_gauge(
+            "gome_wal_replay_frames",
+            "Order-log messages rewound for replay by the last restore",
+            lambda: float(self.wal_replay_frames),
+        )
+
+    def probe(self) -> dict:
+        """TimelineSampler probe: snapshot cadence + recovery state."""
+        return {
+            "snapshots_taken": self.snapshots_taken,
+            "snapshot_age_s": round(self.snapshot_age_seconds(), 3),
+            "snapshot_bytes": self.last_snapshot_bytes,
+            "last_restore": self.last_restore,
+            "recovery_s": round(self.last_recovery_seconds, 6),
+            "wal_replay_frames": self.wal_replay_frames,
+        }

@@ -30,6 +30,21 @@ class EngineService:
     def __init__(self, config: Config | None = None, persist=None):
         self.config = config or Config()
         configure_logging()
+        if self.config.faults.enabled:
+            # Arm the deterministic fault-injection registry (utils.faults)
+            # BEFORE the bus exists so boot-time injection points (torn
+            # sidecar reads, first appends) are covered. Chaos/test
+            # tooling only; without a `faults:` section FAULTS stays a
+            # zero-allocation no-op.
+            from ..utils.faults import FAULTS
+
+            FAULTS.install(self.config.faults.fault_plan())
+            log.warning(
+                "fault injection ARMED (seed=%d, %d specs) — chaos/test "
+                "mode, never production",
+                self.config.faults.seed,
+                len(self.config.faults.fault_plan().faults),
+            )
         self.bus = make_bus(self.config.bus)
         e = self.config.engine
         mesh = None
@@ -79,10 +94,7 @@ class EngineService:
                     st.host, st.port, exc,
                 )
         self.persist = persist  # gome_tpu.persist.Persister or None
-        on_batch = None
-        if persist is not None:
-            persist.attach(self.engine, self.bus)
-            on_batch = persist.on_batch
+        on_batch = persist.on_batch if persist is not None else None
         self.feed = MatchFeed(self.bus)
         self.consumer = OrderConsumer(
             self.engine,
@@ -92,6 +104,13 @@ class EngineService:
             match_wire=self.config.bus.match_wire,
             pipeline_depth=e.pipeline_depth,
         )
+        if persist is not None:
+            # The consumer rides along so snapshots carry the matchfeed
+            # seq at the cut and restore rebases it (exactly-once across
+            # restarts); the durability gauges read from the Persister at
+            # scrape time.
+            persist.attach(self.engine, self.bus, consumer=self.consumer)
+            persist.export_metrics()
         from ..engine.step import LOT_MAX32
 
         self.gateway = OrderGateway(

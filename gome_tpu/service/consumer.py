@@ -18,6 +18,7 @@ import threading
 
 from ..bus import QueueBus, decode_orders_batch
 from ..engine.orchestrator import MatchEngine
+from ..utils.faults import FAULTS
 from ..utils.logging import get_logger
 from ..utils.metrics import REGISTRY
 from ..utils.resilience import BackoffPolicy, backoff_delays
@@ -117,9 +118,25 @@ class OrderConsumer:
         # queue offset (pipelined mode publishes/completes at resolve
         # time, which can be several steps after the feed).
         self._pipe_tids: dict[int, list] = {}
+        # Matchfeed sequence numbers (ISSUE 11 exactly-once): match_seq is
+        # the next seq to stamp — monotonic per book epoch, advanced by
+        # _publish. _seq_committed is its value at the last durable
+        # order-queue commit; a failed step rolls match_seq back to it so
+        # the at-least-once replay regenerates IDENTICAL seqs (duplicates
+        # carry the same seq and are suppressed by SeqTracker downstream).
+        self.match_seq = 0
+        self._seq_committed = 0
         self._last_step_failed = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def reset_seq(self, seq: int) -> None:
+        """Recovery hook (persist.Persister.restore_latest): rebase the
+        matchfeed seq to the restored cut's manifest value. WAL replay
+        then regenerates the truncated match tail with the same seqs it
+        had pre-crash."""
+        self.match_seq = seq
+        self._seq_committed = seq
 
     def _consume_traces(self, cols: dict, headers) -> list:
         """Order-lifecycle tracing, receipt side: pop the GCO3 trace
@@ -173,15 +190,28 @@ class OrderConsumer:
     def _publish(self, batch) -> None:
         # Frame publishing needs real EventBatch columns; the sharded
         # facade's compatibility wrapper (router._ResultsBatch) publishes
-        # reference JSON instead.
+        # reference JSON instead. Every event is stamped with the next
+        # matchfeed seq (GCE2 header / JSON "Seq" / AMQP x-seq);
+        # match_seq only advances once the publish SUCCEEDED, so a failed
+        # publish replays with the same seqs.
+        seq0 = self.match_seq
+        n = len(batch)
         if self.match_wire == "frame" and hasattr(batch, "columns"):
             from ..bus.colwire import encode_event_frame
 
-            if len(batch):
-                self.bus.match_queue.publish(encode_event_frame(batch))
+            if n:
+                mq = self.bus.match_queue
+                frame = encode_event_frame(batch, seq0=seq0)
+                if mq.supports_headers:
+                    # Alongside PR 2's x-trace: stringified per AMQP
+                    # header conventions (bus/amqp.py).
+                    mq.publish(frame, headers={"x-seq": str(seq0)})
+                else:
+                    mq.publish(frame)
         else:
             # one write+fsync for the whole batch on the native backend
-            self.bus.match_queue.publish_batch(batch.to_json_lines())
+            self.bus.match_queue.publish_batch(batch.to_json_lines(seq0=seq0))
+        self.match_seq = seq0 + n
 
     def run_once(self) -> int:  # gomelint: hotpath
         """Drain one micro-batch; returns the number of orders processed."""
@@ -201,6 +231,7 @@ class OrderConsumer:
             # producers can share the queue (migration story).
             i = 0
             while i < len(msgs):
+                FAULTS.fire("consumer.frame")
                 if is_frame(msgs[i].body):
                     with annotate("engine_process_frame"):
                         cols = decode_order_frame(msgs[i].body)
@@ -223,7 +254,9 @@ class OrderConsumer:
             # Commit only after results are published: a crash between
             # processing and commit replays the batch (at-least-once;
             # recovery dedup lives in gome_tpu.persist's replay logic).
+            FAULTS.fire("consumer.commit")
             self.bus.order_queue.commit(msgs[-1].offset + 1)
+            self._seq_committed = self.match_seq
         for tid in done_tids:  # journeys are complete once committed
             TRACER.complete(tid)
         _orders_total.inc(n_orders)
@@ -272,7 +305,9 @@ class OrderConsumer:
         with annotate("publish_events"), TRACER.batch(tids), \
                 TRACER.span("publish"):
             self._publish(batch)
+        FAULTS.fire("consumer.commit")
         self.bus.order_queue.commit(offset + 1)
+        self._seq_committed = self.match_seq
         self._account(n, len(batch))
         for tid in tids:
             TRACER.complete(tid)
@@ -324,6 +359,7 @@ class OrderConsumer:
                         n_orders += self._emit_resolved(*out)
                 i = 0
                 while i < len(msgs):
+                    FAULTS.fire("consumer.frame")
                     m = msgs[i]
                     if is_frame(m.body):
                         cols = decode_order_frame(m.body)
@@ -345,6 +381,7 @@ class OrderConsumer:
                             n_orders += self._emit_resolved(*out)
                         j, n_o, n_e, jtids = self._process_json_run(msgs, i)
                         q.commit(msgs[j - 1].offset + 1)
+                        self._seq_committed = self.match_seq
                         n_orders += n_o
                         self._account(n_o, n_e)
                         for tid in jtids:
@@ -419,6 +456,10 @@ class OrderConsumer:
             self._fail_count = 0
             return n
         except Exception:  # keep consuming; reference panics instead
+            # Seq rollback to the last durable commit: the replay from the
+            # uncommitted offset re-publishes with IDENTICAL seqs, so any
+            # double-delivery is detectable (and suppressed) downstream.
+            self.match_seq = self._seq_committed
             self._last_step_failed = True
             _step_failures.inc()
             log.exception("order batch failed")
@@ -469,11 +510,13 @@ class OrderConsumer:
                     m.offset,
                 )
                 self.bus.order_queue.commit(m.offset + 1)
+                self._seq_committed = self.match_seq
                 continue
             ok, n_ok = self._bisect_apply(orders)
             if not ok:
                 return processed  # publish hiccup: leave offset for replay
             self.bus.order_queue.commit(m.offset + 1)
+            self._seq_committed = self.match_seq
             processed += n_ok
             _orders_total.inc(n_ok)
             if self.on_batch is not None:

@@ -15,8 +15,67 @@ from ..bus import QueueBus, decode_match_result
 from ..fixed import unscale
 from ..types import MatchResult, OrderSnapshot
 from ..utils.logging import get_logger
+from ..utils.metrics import REGISTRY
 
 log = get_logger("matchfeed")
+
+_dupes_total = REGISTRY.counter(
+    "gome_matchfeed_dupes_total",
+    "duplicate matchfeed seqs observed (suppressed before fan-out)",
+)
+_gaps_total = REGISTRY.counter(
+    "gome_matchfeed_gaps_total",
+    "missing matchfeed seqs observed (events lost upstream)",
+)
+
+
+class SeqTracker:
+    """Subscriber-side exactly-once guard over matchfeed seq numbers.
+
+    ``observe(seq)`` returns False for an already-seen seq (the caller
+    suppresses the event) and True otherwise, counting dupes and gaps as
+    it goes. The baseline is the FIRST observed seq: a subscriber
+    attaching mid-stream must not count everything before its attach
+    point as a gap. Pass ``first_seq`` to anchor the stream start instead
+    (e.g. 0 for a full-stream audit of a queue read from offset 0).
+
+    A duplicate only rewinds, never re-counts: seqs at or below the
+    high-water mark are dupes; anything above it contributes
+    ``seq - last - 1`` gaps. Unstamped events (seq None) pass through
+    untracked — mixed legacy streams stay deliverable.
+    """
+
+    def __init__(self, first_seq: int | None = None):
+        self.last_seq: int | None = (
+            None if first_seq is None else first_seq - 1
+        )
+        self.dupes = 0
+        self.gaps = 0
+        self.observed = 0
+
+    def observe(self, seq: int) -> bool:
+        self.observed += 1
+        last = self.last_seq
+        if last is None:
+            self.last_seq = seq
+            return True
+        if seq <= last:
+            self.dupes += 1
+            _dupes_total.inc()
+            return False
+        if seq > last + 1:
+            self.gaps += seq - last - 1
+            _gaps_total.inc(seq - last - 1)
+        self.last_seq = seq
+        return True
+
+    def state(self) -> dict:
+        return {
+            "last_seq": self.last_seq,
+            "observed": self.observed,
+            "dupes": self.dupes,
+            "gaps": self.gaps,
+        }
 
 
 def snapshot_to_pb(s: OrderSnapshot) -> pb.OrderSnapshot:
@@ -49,6 +108,12 @@ class MatchFeed:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.events_seen = 0
+        # Exactly-once guard: dupes (same event re-delivered by the
+        # at-least-once replay window) are suppressed before fan-out, so
+        # subscribers see each seq at most once; gaps are counted loudly
+        # (a gap after recovery is a durability bug, never expected).
+        self.seq = SeqTracker()
+        self.suppressed = 0
 
     def run_once(self) -> int:
         msgs = self.bus.match_queue.poll_batch(256, 0.002)
@@ -66,6 +131,9 @@ class MatchFeed:
             else:
                 results = [decode_match_result(m.body)]
             for mr in results:
+                if mr.seq is not None and not self.seq.observe(mr.seq):
+                    self.suppressed += 1
+                    continue
                 self.events_seen += 1
                 if self.log_events:
                     # rabbitmq.go:170's util.Info.Printf of the result
@@ -87,6 +155,10 @@ class MatchFeed:
         while self.bus.match_queue.committed() < self.bus.match_queue.end_offset():
             total += self.run_once()
         return total
+
+    def seq_state(self) -> dict:
+        """Exactly-once state for /durability."""
+        return {**self.seq.state(), "suppressed": self.suppressed}
 
     def subscribe(self, context=None):
         """Generator of pb.MatchEvent for one subscriber (gateway streaming

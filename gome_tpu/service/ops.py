@@ -20,6 +20,9 @@ one stdlib ThreadingHTTPServer, no dependencies, curl-able:
     curl localhost:9109/hostprof    # host-CPU stage attribution (?drill=1
                                     # runs the admit drill; ?format=collapsed
                                     # dumps flamegraph-ready stacks)
+    curl localhost:9109/durability  # snapshot cadence, recovery state,
+                                    # matchfeed exactly-once tracker,
+                                    # fault-injection report
 
 Enabled by an `ops:` section in config.yaml (port, host) or by
 constructing OpsServer directly around any EngineService.
@@ -132,6 +135,48 @@ class OpsServer:
                 dtype = np.dtype(engine.config.dtype).name
         return PROFILER.payload(dtype=dtype, refresh=refresh)
 
+    def durability_payload(self) -> dict:
+        """The /durability JSON document: the crash-consistency surface in
+        one read — Persister state (snapshot cadence, last restore,
+        recovery timing), queue offsets (published / committed per
+        queue), the matchfeed exactly-once tracker, and the fault-
+        injection registry's report (plan + hit counts; `enabled: false`
+        outside chaos runs). Every field is a scrape-time read."""
+        from ..utils.faults import FAULTS
+
+        svc = self.service
+        payload: dict = {"faults": FAULTS.report()}
+        persist = getattr(svc, "persist", None)
+        payload["persist"] = (
+            persist.probe() if persist is not None else None
+        )
+        feed = getattr(svc, "feed", None)
+        payload["matchfeed"] = (
+            feed.seq_state()
+            if feed is not None and hasattr(feed, "seq_state")
+            else None
+        )
+        consumer = getattr(svc, "consumer", None)
+        if consumer is not None:
+            payload["consumer"] = {
+                "match_seq": getattr(consumer, "match_seq", None),
+            }
+        bus = getattr(svc, "bus", None)
+        queues = {}
+        for qname in ("order_queue", "match_queue"):
+            q = getattr(bus, qname, None)
+            if q is None or not hasattr(q, "end_offset"):
+                continue
+            try:
+                queues[qname] = {
+                    "end": q.end_offset(),
+                    "committed": q.committed(),
+                }
+            except Exception:  # a dead backend must not 500 the payload
+                queues[qname] = {"error": "unreadable"}
+        payload["queues"] = queues
+        return payload
+
     def hostprof_payload(self, run_drill: bool = False) -> dict:
         """The /hostprof JSON document: the host-CPU sampling profiler
         (gome_tpu.obs.hostprof.HOSTPROF) — the live wall-profile stage
@@ -217,6 +262,11 @@ class OpsServer:
                             default=str,
                         ).encode()
                         self._send(200, body, "application/json")
+                    elif self.path.split("?")[0] == "/durability":
+                        body = json.dumps(
+                            ops.durability_payload(), default=str
+                        ).encode()
+                        self._send(200, body, "application/json")
                     elif self.path.split("?")[0] == "/trace":
                         rec = ops.tracer.recorder
                         dump = (
@@ -242,7 +292,7 @@ class OpsServer:
         )
         self._thread.start()
         log.info("ops endpoint up on %s:%d (/metrics, /healthz, /trace, "
-                 "/cost, /timeline, /profile, /hostprof)",
+                 "/cost, /timeline, /profile, /hostprof, /durability)",
                  self.host, self.port)
         return self
 

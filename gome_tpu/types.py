@@ -105,6 +105,11 @@ class MatchResult:
     node: OrderSnapshot
     match_node: OrderSnapshot
     match_volume: int
+    # Matchfeed sequence number (monotonic per book epoch; ISSUE 11
+    # exactly-once). None when the producer predates seq stamping —
+    # excluded from equality so a stamped event still compares equal to
+    # its unstamped twin (replay, oracle parity), like Order.trace.
+    seq: int | None = field(default=None, compare=False, repr=False)
 
     @property
     def is_cancel(self) -> bool:

@@ -207,6 +207,30 @@ def gateway_advisory() -> dict:
         return {"gateway.advisory_error": f"{type(exc).__name__}: {exc}"}
 
 
+def recovery_advisory() -> dict:
+    """Crash-recovery surface (ISSUE 11), ADVISORY only — wall-clock.
+
+    Sourced from the committed chaos verdict (CHAOS_r01.json at the repo
+    root, regenerated by scripts/chaos.py): recovery-time p50 and the
+    WAL-replay rate measured across that run's kill/restart cycles. A
+    missing or unreadable verdict degrades to an error row."""
+    try:
+        path = os.path.join(ROOT, "CHAOS_r01.json")
+        with open(path) as f:
+            verdict = json.load(f)
+        rec = verdict["recovery"]
+        return {
+            "recovery.p50_s": rec["p50_s"],
+            "recovery.wal_replay_frames_per_s": (
+                rec["wal_replay_frames_per_s"]
+            ),
+            "recovery.kills": verdict["config"]["kills"],
+            "recovery.verdict_pass": bool(verdict["pass"]),
+        }
+    except Exception as exc:  # pragma: no cover - env-specific
+        return {"recovery.advisory_error": f"{type(exc).__name__}: {exc}"}
+
+
 def collect() -> dict:
     """{"jax": version, "gated": {...}, "advisory": {...}}."""
     import jax
@@ -219,6 +243,7 @@ def collect() -> dict:
     advisory = drill["advisory"]
     advisory.update(skew_advisory())
     advisory.update(gateway_advisory())
+    advisory.update(recovery_advisory())
     return {
         "jax": jax.__version__,
         "gated": gated,
@@ -357,6 +382,21 @@ def main(argv: list[str] | None = None) -> int:
                 f"the ROADMAP open-item-2 target {SKEW_TARGET} — "
                 "skew-aware placement still pending"
             )
+    rec_p50 = current["advisory"].get("recovery.p50_s")
+    if rec_p50 is not None:
+        print(
+            f"# ADVISORY (never gated, wall-clock): crash recovery p50 "
+            f"{rec_p50:.4f}s, WAL replay "
+            f"{current['advisory'].get('recovery.wal_replay_frames_per_s')} "
+            "frames/s across the committed chaos run (CHAOS_r01.json; "
+            "regenerate with scripts/chaos.py)"
+        )
+    if current["advisory"].get("recovery.verdict_pass") is False:
+        print(
+            "# WARNING (advisory, non-gating): the committed chaos "
+            "verdict has pass=false — tests/test_chaos.py should be "
+            "failing; investigate before trusting recovery numbers"
+        )
     if regressions:
         print(f"perf_ratchet: {len(regressions)} regressed metric(s):")
         for r in regressions:

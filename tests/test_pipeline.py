@@ -61,12 +61,18 @@ def _assert_books_equal(a: MatchEngine, b: MatchEngine):
 
 
 def _oracle_lines(orders):
+    # The consumer stamps every published event with the matchfeed seq
+    # (ISSUE 11 exactly-once), so the expected wire carries the same
+    # contiguous "Seq" fields the reference-shaped body lacks.
+    from dataclasses import replace
+
     from gome_tpu.bus import encode_match_result
 
     oracle = OracleEngine()
     out = []
     for o in orders:
-        out.extend(encode_match_result(r) for r in oracle.process(o))
+        for r in oracle.process(o):
+            out.append(encode_match_result(replace(r, seq=len(out))))
     return out
 
 
