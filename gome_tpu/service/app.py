@@ -15,6 +15,8 @@ tests/test_multiprocess.py::test_three_process_prepool_reference_topology).
 
 from __future__ import annotations
 
+import os
+
 from ..bus import make_bus
 from ..config import Config
 from ..engine.orchestrator import MatchEngine
@@ -181,6 +183,14 @@ class EngineService:
             self.ops = OpsServer(
                 self, host=self.config.ops.host, port=self.config.ops.port
             )
+        if os.environ.get("GOME_RACECHECK") == "1":
+            # Arm the dynamic lockset race detector (analysis.racecheck)
+            # over the service's cross-thread hotspots — the CI race
+            # drill's hook. Local import behind the env check: a normal
+            # boot neither imports nor pays for it.
+            from ..analysis.racecheck import maybe_arm
+
+            maybe_arm(self)
 
     def start(self):
         """Start gRPC server + consumer + feed threads (+ the ops HTTP
