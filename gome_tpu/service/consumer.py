@@ -99,11 +99,14 @@ class OrderConsumer:
         self.batch_n = batch_n
         self.batch_wait_s = batch_wait_s
         self.pipeline_depth = pipeline_depth
-        self._pipe = None  # lazily-built FramePipeline (pipeline_depth > 0)
+        # single-writer: the consuming thread — the _loop thread once
+        # start()ed, or the sync run_once()/drain()/pump() caller; the
+        # two modes never run concurrently (start() is the boundary).
+        self._pipe = None  # single-writer: the consuming thread (lazy FramePipeline)
         # Persist-hook counts deferred to the next pipeline-empty boundary
         # (on_batch must only observe consistent cuts; see _emit_resolved).
-        self._hook_orders = 0
-        self._hook_events = 0
+        self._hook_orders = 0  # single-writer: the consuming thread
+        self._hook_events = 0  # single-writer: the consuming thread
         self.on_batch = on_batch  # callback(n_orders, n_events): persist hook
         # Poison-batch policy: a deterministic per-batch error (e.g. a lane
         # CapacityError) would otherwise replay the same uncommitted offset
@@ -112,8 +115,8 @@ class OrderConsumer:
         # replayed order-by-order and the offending orders dead-lettered
         # (logged + counted) so the stream advances.
         self.poison_threshold = poison_threshold
-        self._fail_offset = -1
-        self._fail_count = 0
+        self._fail_offset = -1  # single-writer: the consuming thread
+        self._fail_count = 0  # single-writer: the consuming thread
         # Order-lifecycle tracing: in-flight frames' journey ids keyed by
         # queue offset (pipelined mode publishes/completes at resolve
         # time, which can be several steps after the feed).
@@ -124,19 +127,24 @@ class OrderConsumer:
         # order-queue commit; a failed step rolls match_seq back to it so
         # the at-least-once replay regenerates IDENTICAL seqs (duplicates
         # carry the same seq and are suppressed by SeqTracker downstream).
-        self.match_seq = 0
-        self._seq_committed = 0
-        self._last_step_failed = False
+        self.match_seq = 0  # single-writer: the consuming thread
+        self._seq_committed = 0  # single-writer: the consuming thread
+        self._last_step_failed = False  # single-writer: the consuming thread
         self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
+        self._life = threading.Lock()  # serializes start()/stop()
+        self._thread: threading.Thread | None = None  # guarded by self._life
 
     def reset_seq(self, seq: int) -> None:
         """Recovery hook (persist.Persister.restore_latest): rebase the
         matchfeed seq to the restored cut's manifest value. WAL replay
         then regenerates the truncated match tail with the same seqs it
         had pre-crash."""
-        self.match_seq = seq
-        self._seq_committed = seq
+        # gomelint: disable=GL704 — happens-before, not a second writer:
+        # restore_latest() runs during EngineService.start() BEFORE
+        # consumer.start() spawns the loop (app.py orders them), and the
+        # chaos/recovery drills call it on a stopped consumer.
+        self.match_seq = seq  # gomelint: disable=GL704
+        self._seq_committed = seq  # gomelint: disable=GL704
 
     def _consume_traces(self, cols: dict, headers) -> list:
         """Order-lifecycle tracing, receipt side: pop the GCO3 trace
@@ -423,13 +431,19 @@ class OrderConsumer:
 
     # -- background loop -----------------------------------------------------
     def start(self) -> None:
-        if self._thread is not None:
-            raise RuntimeError("consumer already started")
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._loop, name="order-consumer", daemon=True
-        )
-        self._thread.start()
+        # Serialized with stop() under _life: the watchdog restarts a
+        # dead consumer from ITS thread while service shutdown (or an
+        # operator) may be stopping it from another — without the lock
+        # two start() calls can both pass the None check and spawn two
+        # consumer loops (doubled batches, lost joins).
+        with self._life:
+            if self._thread is not None:
+                raise RuntimeError("consumer already started")
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="order-consumer", daemon=True
+            )
+            self._thread.start()
 
     # gomelint: hotpath
     def _loop(self) -> None:
@@ -570,7 +584,10 @@ class OrderConsumer:
         return True, len(orders)
 
     def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
-            self._thread = None
+        # The consumer loop never takes _life, so joining under it cannot
+        # deadlock; concurrent stop()s serialize harmlessly.
+        with self._life:
+            self._stop.set()
+            if self._thread is not None:
+                self._thread.join(timeout=10)
+                self._thread = None

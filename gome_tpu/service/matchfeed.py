@@ -46,12 +46,16 @@ class SeqTracker:
     """
 
     def __init__(self, first_seq: int | None = None):
-        self.last_seq: int | None = (
+        # single-writer (all counters): the observe() caller — one
+        # delivery thread per tracker (the matchfeed fan-out loop, or the
+        # chaos verdict's replay walk). state() readers tolerate
+        # staleness; ints rebind atomically under the GIL.
+        self.last_seq: int | None = (  # single-writer: observe() caller
             None if first_seq is None else first_seq - 1
         )
-        self.dupes = 0
-        self.gaps = 0
-        self.observed = 0
+        self.dupes = 0  # single-writer: observe() caller
+        self.gaps = 0  # single-writer: observe() caller
+        self.observed = 0  # single-writer: observe() caller
 
     def observe(self, seq: int) -> bool:
         self.observed += 1
@@ -105,15 +109,16 @@ class MatchFeed:
         self.log_events = log_events
         self._subs: list[queue.Queue] = []  # guarded by self._lock
         self._lock = threading.Lock()
+        self._life = threading.Lock()  # serializes start()/stop()
         self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
-        self.events_seen = 0
+        self._thread: threading.Thread | None = None  # guarded by self._life
+        self.events_seen = 0  # single-writer: the feed thread (run_once)
         # Exactly-once guard: dupes (same event re-delivered by the
         # at-least-once replay window) are suppressed before fan-out, so
         # subscribers see each seq at most once; gaps are counted loudly
         # (a gap after recovery is a durability bug, never expected).
         self.seq = SeqTracker()
-        self.suppressed = 0
+        self.suppressed = 0  # single-writer: the feed thread (run_once)
 
     def run_once(self) -> int:
         msgs = self.bus.match_queue.poll_batch(256, 0.002)
@@ -181,13 +186,19 @@ class MatchFeed:
 
     # -- background loop -----------------------------------------------------
     def start(self) -> None:
-        if self._thread is not None:
-            raise RuntimeError("feed already started")
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._loop, name="match-feed", daemon=True
-        )
-        self._thread.start()
+        # Serialized with stop() under _life: the watchdog restarts a
+        # dead feed from ITS thread while an operator (or service
+        # shutdown) may be starting/stopping it from another — without
+        # the lock two start() calls can both pass the None check and
+        # spawn two fan-out loops (double delivery, lost joins).
+        with self._life:
+            if self._thread is not None:
+                raise RuntimeError("feed already started")
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="match-feed", daemon=True
+            )
+            self._thread.start()
 
     def _loop(self) -> None:
         from ..utils.resilience import backoff_delays
@@ -205,7 +216,10 @@ class MatchFeed:
                 self._stop.wait(next(delays, FAULT_BACKOFF.max_s))
 
     def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
-            self._thread = None
+        # The feed loop never takes _life, so joining under it cannot
+        # deadlock; concurrent stop()s serialize harmlessly.
+        with self._life:
+            self._stop.set()
+            if self._thread is not None:
+                self._thread.join(timeout=10)
+                self._thread = None

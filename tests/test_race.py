@@ -332,6 +332,80 @@ def test_arm_service_watches_feed_and_consumer():
     RACECHECK.disable()
 
 
+# -- the double-start lifecycle race (fixed this round) ---------------------
+
+
+def _double_start(seed: int):
+    """Two workers race MatchFeed.start() under one seeded schedule,
+    with the exact pre-fix window — the `_stop.clear()` between the
+    already-started check and the thread assignment — turned into a
+    schedule point."""
+    from gome_tpu.bus import MemoryQueue, QueueBus
+    from gome_tpu.service.matchfeed import MatchFeed
+
+    bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    feed = MatchFeed(bus, log_events=False)
+    il = Interleaver(seed=seed)
+    # _life must step (a worker holding it descheduled mid-start would
+    # otherwise wedge the schedule); _stop.clear() IS the race window.
+    feed._life = SteppingLock(il.step)
+    feed._stop = SteppingEvent(il.step)
+    il.run(lambda step: feed.start(), lambda step: feed.start())
+    try:
+        live = [
+            t for t in threading.enumerate() if t.name == "match-feed"
+        ]
+        return il, live
+    finally:
+        feed.stop()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 7, 1234])
+def test_matchfeed_double_start_is_serialized(seed):
+    """Regression for the watchdog-vs-operator double start: before the
+    _life lock, a schedule that deschedules worker A between the
+    `_thread is None` check and the assignment let both workers spawn a
+    fan-out loop (double delivery, lost join). Post-fix, EVERY seeded
+    schedule yields exactly one winner, one RuntimeError loser, one
+    live feed thread."""
+    il, live = _double_start(seed)
+    errors = [e for e in il.errors if e is not None]
+    assert len(errors) == 1, f"trace {il.trace}: errors {il.errors}"
+    assert isinstance(errors[0], RuntimeError)
+    assert len(live) == 1, f"trace {il.trace}: {live}"
+
+
+def test_consumer_double_start_is_serialized():
+    """Same lifecycle contract on the order consumer (its start() got
+    the same _life serialization this round)."""
+    from gome_tpu.bus import MemoryQueue, QueueBus
+    from gome_tpu.engine import BookConfig
+    from gome_tpu.engine.orchestrator import MatchEngine
+    from gome_tpu.service.consumer import OrderConsumer
+
+    engine = MatchEngine(
+        config=BookConfig(cap=16, max_fills=4), n_slots=16, max_t=4
+    )
+    bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    consumer = OrderConsumer(engine, bus, batch_n=16, batch_wait_s=0)
+    il = Interleaver(seed=5)
+    consumer._life = SteppingLock(il.step)
+    il.run(
+        lambda step: consumer.start(), lambda step: consumer.start()
+    )
+    try:
+        errors = [e for e in il.errors if e is not None]
+        assert len(errors) == 1 and isinstance(errors[0], RuntimeError)
+        live = [
+            t
+            for t in threading.enumerate()
+            if t.name == "order-consumer"
+        ]
+        assert len(live) == 1
+    finally:
+        consumer.stop()
+
+
 def test_private_detector_instances_are_independent():
     """Tests may build private RaceCheck instances without touching the
     process-wide singleton's state."""
