@@ -24,6 +24,14 @@ package checks those contracts *statically*, before a soak test runs:
   GL6xx  buffer-donation   — jitted entries that double-buffer dead state
                              arguments, no-op donations, and use-after-
                              donation call sites (analysis.donation)
+  GL7xx  thread-escape     — attributes reachable from more than one
+                             thread (Thread-owning classes, module
+                             singletons, transitive construction) mutated
+                             without a `# guarded by` / `# single-writer`
+                             contract (analysis.threads); the dynamic
+                             companion — an Eraser-style lockset detector
+                             + seeded interleaving driver — lives in
+                             analysis.racecheck / analysis.interleave
 
 Run it via ``python scripts/gomelint.py gome_tpu`` (CI's analysis job) or
 programmatically through :func:`run_paths`. Findings carry stable rule

@@ -160,7 +160,14 @@ def _collect(module: SourceModule, select: set[str] | None,
 
 def _ensure_checkers_loaded() -> None:
     # Import-time registration; local imports avoid a hard cycle.
-    from . import donation, locks, recompile, trace_safety, transfers  # noqa: F401
+    from . import (  # noqa: F401
+        donation,
+        locks,
+        recompile,
+        threads,
+        trace_safety,
+        transfers,
+    )
 
 
 def _run_project(modules: list[SourceModule], select: set[str] | None,

@@ -218,8 +218,13 @@ class AmqpQueue(Queue, _Waitable):
         self._lock = threading.RLock()  # socket writes + state
         self._rpc_lock = threading.Lock()  # one outstanding sync RPC
         self._rpc_event = threading.Event()
-        self._rpc_reply: tuple | None = None  # (token, (cls, mth, payload))
-        self._rpc_expect: tuple | None = None  # ((cls, mth), token)
+        # (token, (cls, mth, payload)) — an event-mediated handoff slot,
+        # NOT lock-guarded: _rpc nulls it (under _rpc_lock) before each
+        # send, the reader stores into it and sets _rpc_event, and the
+        # waiter reads it only after the event fires (happens-before via
+        # Event). Mutation sites carry explicit GL70x suppressions.
+        self._rpc_reply: tuple | None = None
+        self._rpc_expect: tuple | None = None  # guarded by self._rpc_lock — ((cls, mth), token)
         self._rpc_seq = 0  # guarded by self._rpc_lock (token source, _rpc)
         self._buffer: list[bytes] = []  # guarded by self._lock (arrivals)
         self._tags: list[int] = []  # guarded by self._lock (tag/arrival)
@@ -228,16 +233,20 @@ class AmqpQueue(Queue, _Waitable):
         self._committed = 0  # guarded by self._lock
         self._acked_through = 0  # guarded by self._lock (broker-acked)
         self._published = 0  # guarded by self._lock (loopback sync)
-        self._consuming = False
+        self._consuming = False  # single-writer: the polling thread (_ensure_consuming)
+        # One-way latch: ANY thread (rpc waiter, sender, reader, closer)
+        # may flip it False->True, and it never goes back. Readers
+        # tolerate staleness — paths where it matters re-check under the
+        # relevant lock. Mutation sites carry explicit GL70x suppressions.
         self._closed = False
-        self._frame_max = 131072
-        self._pending_deliver: tuple | None = None
+        self._frame_max = 131072  # single-writer: __init__'s handshake (pre-thread)
+        self._pending_deliver: tuple | None = None  # single-writer: the reader thread
         self._confirm = False  # set after Confirm.Select below
         self._pub_seq = 0  # guarded by self._lock (1-based confirm tags)
         self._confirmed = 0  # guarded by self._ack_cond (ack frontier)
         self._ack_cond = threading.Condition()
 
-        self._heartbeat = 0
+        self._heartbeat = 0  # single-writer: __init__'s handshake (pre-thread)
         self._sock = socket.create_connection(
             (host, port), timeout=connect_timeout_s
         )
@@ -346,7 +355,7 @@ class AmqpQueue(Queue, _Waitable):
             self._rpc_seq += 1
             token = self._rpc_seq
             self._rpc_expect = (expect, token)
-            self._rpc_reply = None  # fresh slot: reader stores, we read
+            self._rpc_reply = None  # fresh slot: reader stores, we read  # gomelint: disable=GL702 — event-handoff slot (see __init__)
             self._rpc_event.clear()
             try:
                 with self._lock:
@@ -355,7 +364,7 @@ class AmqpQueue(Queue, _Waitable):
                     # The reply is now an untracked in-flight frame; any
                     # further sync RPC on this channel could adopt it.
                     # Fail the connection: callers reconnect fresh.
-                    self._closed = True
+                    self._closed = True  # gomelint: disable=GL702 — one-way latch (see __init__)
                     try:
                         self._sock.close()
                     except OSError:
@@ -375,7 +384,7 @@ class AmqpQueue(Queue, _Waitable):
                     # reply is still in flight and untracked, so a retry
                     # on this connection could adopt it. Fail the
                     # connection before raising.
-                    self._closed = True
+                    self._closed = True  # gomelint: disable=GL702 — one-way latch (see __init__)
                     try:
                         self._sock.close()
                     except OSError:
@@ -454,7 +463,7 @@ class AmqpQueue(Queue, _Waitable):
                             )
                     off += sent
         except (socket.timeout, OSError) as e:
-            self._closed = True
+            self._closed = True  # gomelint: disable=GL701 — one-way latch (see __init__)
             try:
                 self._sock.close()
             except OSError:
@@ -513,12 +522,18 @@ class AmqpQueue(Queue, _Waitable):
                                 self._confirmed = tag
                             self._ack_cond.notify_all()
                         continue
-                    expect = self._rpc_expect  # one read: (target, token)
+                    # Benign off-lock read: one reference load under the
+                    # GIL; a stale value only means a reply is dropped or
+                    # token-rejected, which the waiter's timeout/token
+                    # validation is designed to absorb.
+                    expect = self._rpc_expect  # gomelint: disable=GL402 — see above
                     if expect is not None and expect[0] == (
                         class_id,
                         method_id,
                     ):
-                        self._rpc_reply = (
+                        # Event-handoff slot (see __init__): the store
+                        # happens-before the waiter's read via _rpc_event.
+                        self._rpc_reply = (  # gomelint: disable=GL701 — see above
                             expect[1],
                             (class_id, method_id, payload),
                         )
@@ -563,7 +578,7 @@ class AmqpQueue(Queue, _Waitable):
                         self._complete_delivery()
         except (ConnectionError, OSError):
             if not self._closed:
-                self._closed = True
+                self._closed = True  # gomelint: disable=GL701 — one-way latch (see __init__)
             # Fail any in-flight RPC NOW (it would otherwise block its
             # full timeout against a connection that is already dead) —
             # but never clobber a reply already stored: the reader can
@@ -738,7 +753,7 @@ class AmqpQueue(Queue, _Waitable):
         with self._lock:
             if self._closed:
                 return
-            self._closed = True
+            self._closed = True  # gomelint: disable=GL702 — one-way latch (see __init__)
             try:
                 close = method(
                     10,

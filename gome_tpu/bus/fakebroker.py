@@ -67,18 +67,18 @@ class _Connection:
     def __init__(self, broker: "FakeBroker", sock: socket.socket):
         self.broker = broker
         self.sock = sock
-        self.closed = False
+        self.closed = False  # single-writer: this connection's reader thread
         self.wlock = threading.Lock()
         self.dlock = threading.Lock()  # delivery-tag + unacked consistency
-        # tag -> (queue, body, headers)
+        # tag -> (queue, body, headers)  # guarded by self.dlock
         self.unacked: dict[int, tuple[str, bytes, dict | None]] = {}
-        self.consuming: list[str] = []
-        self._next_tag = 1
+        self.consuming: list[str] = []  # single-writer: the reader thread
+        self._next_tag = 1  # guarded by self.dlock
         # (queue, bytearray, [size], [headers])
-        self._pending_pub: tuple | None = None
-        self._publishes = 0  # fault-mode accounting
-        self._confirm = False  # publisher-confirm mode (Confirm.Select)
-        self._pub_tag = 0  # confirm-mode ack tag sequence
+        self._pending_pub: tuple | None = None  # single-writer: the reader thread
+        self._publishes = 0  # single-writer: the reader thread (fault accounting)
+        self._confirm = False  # single-writer: the reader thread (Confirm.Select)
+        self._pub_tag = 0  # single-writer: the reader thread (ack tag sequence)
 
     def send(self, data: bytes) -> None:
         with self.wlock:
@@ -315,17 +315,17 @@ class FakeBroker:
         close_abruptly_on_publish: int | None = None,
     ):
         self.host = host
-        self.port = port
+        self.port = port  # single-writer: start() caller (rebound to the bound port)
         self.heartbeat = heartbeat
         self.mute_heartbeats = mute_heartbeats
         self.frame_max = frame_max
         self.channel_close_on_publish = channel_close_on_publish
         self.close_abruptly_on_publish = close_abruptly_on_publish
-        self._server: socket.socket | None = None
+        self._server: socket.socket | None = None  # single-writer: start()/stop() caller
         self._lock = threading.Lock()
         self._queues: dict[str, _BrokerQueue] = {}
         self._conns: list[_Connection] = []
-        self._stop = False
+        self._stop = False  # single-writer: stop() caller
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "FakeBroker":

@@ -50,11 +50,11 @@ class CompileJournal:
     answer "how much compile has this process paid"."""
 
     def __init__(self):
-        self.clock = time.perf_counter
+        self.clock = time.perf_counter  # single-writer: install() caller
         self._lock = threading.Lock()
         self._entries: deque | None = None  # guarded by self._lock
         self._totals: dict[str, list] = {}  # guarded by self._lock
-        self._registry: Registry = REGISTRY
+        self._registry: Registry = REGISTRY  # single-writer: install() caller
 
     @property
     def enabled(self) -> bool:

@@ -189,7 +189,11 @@ def _frame_node(frame) -> str:
     return node
 
 
-class HostSampler:
+# One capture at a time: start()/stop() and the sampling tick (SIGPROF
+# handler or poller thread) are serialized by the capture lifecycle
+# (HostProfiler holds its _lock across arm/disarm), and the handler must
+# never block, so this class carries NO lock by design.
+class HostSampler:  # single-writer: the active capture (see note above)
     """In-process sampling profiler over ``module:function`` stacks.
 
     ``start()`` arms one of two capture modes (module docstring); both
@@ -385,13 +389,16 @@ class HostSampler:
         return "".join(f"{';'.join(s)} {c}\n" for s, c in items)
 
     def reset(self) -> None:
-        self._counts = {}
-        self._ring = deque(maxlen=self.keep)
-        self._samples = 0
-        self._wall_s = self._cpu_s = 0.0
+        # gomelint: disable=GL704 — reset() is part of the capture
+        # lifecycle: it runs before start() arms the tick (or after
+        # stop() disarms it), never concurrently with it.
+        self._counts = {}  # gomelint: disable=GL704
+        self._ring = deque(maxlen=self.keep)  # gomelint: disable=GL704
+        self._samples = 0  # gomelint: disable=GL704
+        self._wall_s = self._cpu_s = 0.0  # gomelint: disable=GL704
         if self._active:
-            self._t0 = time.perf_counter()
-            self._c0 = time.process_time()
+            self._t0 = time.perf_counter()  # gomelint: disable=GL704
+            self._c0 = time.process_time()  # gomelint: disable=GL704
 
 
 # ---------------------------------------------------------------------------
@@ -761,7 +768,7 @@ class HostProfiler:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._sampler: HostSampler | None = None  # armed ⇔ sampler; _lock
+        self._sampler: HostSampler | None = None  # guarded by self._lock (armed ⇔ sampler)
         self._admits: int | None = None  # guarded by self._lock
         self._hz = DEFAULT_HZ  # guarded by self._lock
         self._keep = DEFAULT_KEEP  # guarded by self._lock

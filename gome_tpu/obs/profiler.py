@@ -523,7 +523,7 @@ class Profiler:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._reports: deque | None = None  # armed ⇔ deque; rest _lock
+        self._reports: deque | None = None  # guarded by self._lock (armed ⇔ deque)
         self._shards: deque | None = None  # guarded by self._lock
         self._log_dir: str | None = None  # guarded by self._lock
         self._captures = 0  # guarded by self._lock
@@ -678,7 +678,7 @@ class Profiler:
             "dispatches (ROADMAP open item 2 targets <= 2.0)",
             lambda: self.shard_report().get("rows_per_live_lane_p50") or 0.0,
         )
-        self._registry = reg
+        self._registry = reg  # single-writer: install() caller
 
     def _export_entries(self, rep: dict) -> None:
         reg = getattr(self, "_registry", None)

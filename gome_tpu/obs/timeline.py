@@ -90,16 +90,16 @@ class TimelineSampler:
     can also be driven manually — tests script the clock)."""
 
     def __init__(self):
-        self.clock = time.monotonic
-        self.interval_s = 1.0
+        self.clock = time.monotonic  # single-writer: install() caller
+        self.interval_s = 1.0  # single-writer: install()/start() caller
         self._lock = threading.Lock()
         self._samples: deque | None = None  # guarded by self._lock
         self._frames = 0  # guarded by self._lock
         self._orders = 0  # guarded by self._lock
         self._probes: dict[str, object] = {}
-        self._rusage0 = None
-        self._registry: Registry = REGISTRY
-        self._thread: threading.Thread | None = None
+        self._rusage0 = None  # single-writer: install()/disable() caller
+        self._registry: Registry = REGISTRY  # single-writer: install()/disable() caller
+        self._thread: threading.Thread | None = None  # single-writer: start()/stop() caller
         self._stop = threading.Event()
 
     @property

@@ -62,13 +62,17 @@ class FakeRedisServer:
     bound port; stop() closes the listener and every live connection."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        # single-writer (lifecycle state below): the start()/stop()/
+        # restart() caller — the test or chaos drill driving the bounce;
+        # the accept/client threads only append via method calls, which
+        # the restart drill joins behind stop().
         self.host = host
-        self.port = port
-        self.store = _Store()
-        self._listener: socket.socket | None = None
-        self._threads: list[threading.Thread] = []
-        self._conns: list[socket.socket] = []
-        self._stop = threading.Event()
+        self.port = port  # single-writer: start()/restart() caller
+        self.store = _Store()  # single-writer: restart() caller (kept keyspace)
+        self._listener: socket.socket | None = None  # single-writer: start()/stop() caller
+        self._threads: list[threading.Thread] = []  # single-writer: start()/restart() caller
+        self._conns: list[socket.socket] = []  # single-writer: restart() caller
+        self._stop = threading.Event()  # single-writer: restart() caller (rebound)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> int:
@@ -126,7 +130,10 @@ class FakeRedisServer:
         self._stop = threading.Event()
         self._threads = []
         self._conns = []
-        self.port = port
+        # gomelint: disable=GL704 — false edge: the accept loop's
+        # `t.start()` (a Thread) resolves by bare name to self.start() in
+        # the conservative call graph; only the drill caller runs here.
+        self.port = port  # gomelint: disable=GL704
         self.store = store
         # The dead connections' sockets can hold the port for a beat even
         # with SO_REUSEADDR; retry the bind briefly rather than flaking.

@@ -113,19 +113,22 @@ class Persister:
         """config: gome_tpu.config.PersistConfig."""
         self.store = SnapshotStore(config.dir, keep=config.keep)
         self.every_n = config.every_n_batches
-        self._batches = 0
-        self.engine = None  # MatchEngine
-        self.bus = None
-        self.consumer = None  # OrderConsumer (for matchfeed seq recovery)
-        self.snapshots_taken = 0
-        self.restored = False
+        self._batches = 0  # single-writer: the consuming thread (on_batch)
+        self.engine = None  # MatchEngine  # single-writer: attach() caller
+        self.bus = None  # single-writer: attach() caller
+        self.consumer = None  # single-writer: attach() caller (matchfeed seq recovery)
+        self.snapshots_taken = 0  # single-writer: the consuming thread
+        self.restored = False  # single-writer: restore_latest() caller
         # Durability telemetry (/durability payload, gome_* gauges, the
-        # timeline probe). Written from the consumer thread only.
-        self.last_snapshot_unix = 0.0
-        self.last_snapshot_bytes = 0
-        self.last_restore = "never"  # never | none | replayed | restored
-        self.last_recovery_seconds = 0.0
-        self.wal_replay_frames = 0
+        # timeline probe). Written from the consuming thread / the
+        # restore_latest() caller only; the ops HTTP thread reads it
+        # off-lock (floats and small ints are single-bytecode loads —
+        # stale at worst, never torn).
+        self.last_snapshot_unix = 0.0  # single-writer: the consuming thread
+        self.last_snapshot_bytes = 0  # single-writer: the consuming thread
+        self.last_restore = "never"  # single-writer: restore_latest() caller
+        self.last_recovery_seconds = 0.0  # single-writer: restore_latest() caller
+        self.wal_replay_frames = 0  # single-writer: restore_latest() caller
 
     def attach(self, engine, bus, consumer=None) -> None:
         self.engine = engine

@@ -95,7 +95,7 @@ class FrameBatcher:
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop_event = threading.Event()
-        self._stop = False
+        self._stop = False  # guarded by self._lock (see close())
         self._oldest: float | None = None  # guarded by self._lock
         # Scrape-time callbacks run on the ops HTTP thread WITHOUT the
         # lock on purpose: _flush_locked holds it across a bus publish,
@@ -258,7 +258,10 @@ class FrameBatcher:
                 spilled = bool(self._spill)
             if not spilled:
                 self._wake.wait()
-            if self._stop:
+            # gomelint: disable=GL402 — benign stale read: a bool load is
+            # one bytecode under the GIL; a missed True is caught on the
+            # next wake, and close() sets _wake after _stop.
+            if self._stop:  # gomelint: disable=GL402
                 return
             with self._lock:
                 oldest = self._oldest

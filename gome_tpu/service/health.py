@@ -56,7 +56,7 @@ class HealthMonitor:
         deadlock) — the failure mode liveness alone misses."""
         self.service = service
         self.stall_after_s = stall_after_s
-        self._beat = time.monotonic()
+        self._beat = time.monotonic()  # single-writer: heartbeat() — the consumer loop
 
     def heartbeat(self) -> None:
         self._beat = time.monotonic()
@@ -127,10 +127,10 @@ class Watchdog:
         self.interval_s = interval_s
         self.max_restarts = max_restarts
         self.window_s = window_s
-        self._restart_times: list[float] = []
+        self._restart_times: list[float] = []  # single-writer: the watchdog thread (check_once)
         self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
-        self.gave_up = False
+        self._thread: threading.Thread | None = None  # single-writer: start()/stop() caller
+        self.gave_up = False  # single-writer: the watchdog thread (check_once)
 
     def check_once(self) -> Health:
         h = self.monitor.check()

@@ -52,11 +52,11 @@ class OpsServer:
 
         self.service = service
         self.host = host
-        self.port = port
+        self.port = port  # single-writer: start() caller (rebound to the bound port)
         self.registry = registry
         self.tracer = tracer or TRACER  # /trace reads its flight recorder
-        self._httpd: ThreadingHTTPServer | None = None
-        self._thread: threading.Thread | None = None
+        self._httpd: ThreadingHTTPServer | None = None  # single-writer: start()/stop() caller
+        self._thread: threading.Thread | None = None  # single-writer: start()/stop() caller
         self.monitor = None
         self.live_monitor = None
         if service is not None:

@@ -156,14 +156,14 @@ class FaultRegistry:
     """
 
     def __init__(self) -> None:
-        # The ONLY attribute the disabled hot path reads.
-        self.enabled = False
+        # The ONLY attribute the disabled hot path reads — see fire().
+        self.enabled = False  # guarded by self._lock
         self._lock = threading.Lock()
-        self._plan: FaultPlan | None = None
-        self._by_point: dict[str, list[_Armed]] = {}
-        self._hits: dict[str, int] = {}
-        self._fired_log: list[dict[str, Any]] = []
-        self._handlers: dict[str, Callable[[], None]] = {}
+        self._plan: FaultPlan | None = None  # guarded by self._lock
+        self._by_point: dict[str, list[_Armed]] = {}  # guarded by self._lock
+        self._hits: dict[str, int] = {}  # guarded by self._lock
+        self._fired_log: list[dict[str, Any]] = []  # guarded by self._lock
+        self._handlers: dict[str, Callable[[], None]] = {}  # guarded by self._lock
         # Injectable for tests; chaos children die through this.
         self._exit: Callable[[int], None] = os._exit
 
@@ -195,7 +195,10 @@ class FaultRegistry:
     # -- hot path -------------------------------------------------------
 
     def fire(self, point: str) -> int:
-        if not self.enabled:  # gomelint: hotpath
+        # gomelint: disable=GL402 — benign stale read: a bool load is one
+        # bytecode under the GIL (merely stale, never torn), and install()
+        # happens-before the first armed fire in every harness.
+        if not self.enabled:  # gomelint: hotpath  # gomelint: disable=GL402
             return 0
         return self._fire_armed(point)
 

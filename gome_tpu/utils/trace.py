@@ -321,12 +321,12 @@ class Tracer:
         clock=time.perf_counter,
         new_id=None,
     ):
-        self.clock = clock
-        self.recorder = None
-        self._new_id = new_id
+        self.clock = clock  # single-writer: install() caller (boot/test)
+        self.recorder = None  # single-writer: install()/disable() caller
+        self._new_id = new_id  # single-writer: install() caller (boot/test)
         self._counter = itertools.count(1)
         self._prefix = f"{os.getpid() & 0xFFFF:04x}"
-        self._hist: dict[str, object] = {}
+        self._hist: dict[str, object] = {}  # single-writer: install() caller
         self._local = threading.local()
         if recorder is not None:
             self.install(recorder, registry=registry, clock=clock,

@@ -441,6 +441,193 @@ def test_runtime_instrument_on_real_batcher():
         b.close()
 
 
+# --- GL7xx thread-escape analysis -----------------------------------------
+
+
+BAD_THREADS = '''
+import threading
+
+class Feed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = 0
+        self.state = "idle"
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        self.events += 1
+
+    def set_state(self, s):
+        with self._lock:
+            self.state = s
+'''
+
+
+def test_threads_flags_bad_fixture():
+    findings = run_source(BAD_THREADS)
+    assert rules_of(findings) == ["GL701", "GL702"]
+    lines = {f.rule: f.line for f in findings}
+    assert lines["GL701"] == 12  # self.events += 1, no lock, no contract
+    assert lines["GL702"] == 16  # self.state under an undeclared lock
+    assert "owns a thread" in findings[0].message
+
+
+GOOD_THREADS = '''
+import threading
+
+class Feed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = 0  # guarded by self._lock
+        self.state = "idle"  # single-writer: the fan-out loop
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        with self._lock:
+            self.events += 1
+        self.state = "running"
+'''
+
+
+def test_threads_good_twin_is_clean():
+    assert run_source(GOOD_THREADS) == []
+
+
+def test_threads_singleton_escape_root():
+    """A module-level ALL-CAPS singleton escapes its class even with no
+    thread of its own — every importing thread can reach it."""
+    src = '''
+class Registry:
+    def __init__(self):
+        self.installed = False
+
+    def install(self):
+        self.installed = True
+
+REGISTRY = Registry()
+'''
+    findings = run_source(src)
+    assert rules_of(findings) == ["GL701"]
+    assert "module-level singleton REGISTRY" in findings[0].message
+    # lowercase module assignment is NOT an escape root
+    assert run_source(src.replace("REGISTRY", "_registry")) == []
+
+
+def test_threads_transitive_construction_escapes():
+    """`self.seq = SeqTracker()` inside an escaped class escapes
+    SeqTracker too (its instance rides the shared object)."""
+    src = '''
+import threading
+
+class Tracker:
+    def __init__(self):
+        self.seen = 0
+
+    def observe(self):
+        self.seen += 1
+
+class Feed:
+    def __init__(self):
+        self.seq = Tracker()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        self.seq.observe()
+'''
+    findings = run_source(src)
+    assert rules_of(findings) == ["GL701"]
+    assert "constructed into escaped Feed" in findings[0].message
+
+
+def test_threads_gl703_contradictory_contracts():
+    src = '''
+import threading
+
+class Both:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  # guarded by self._lock; also  # single-writer: loop
+'''
+    findings = run_source(src)
+    # flagged even though Both never escapes: the annotation is
+    # self-contradictory wherever it lives
+    assert rules_of(findings) == ["GL703"]
+
+
+def test_threads_gl704_second_writer_outside_thread():
+    src = '''
+import threading
+
+class Sampler:
+    def __init__(self):
+        self.count = 0  # single-writer: the tick thread
+        self._thread = threading.Thread(target=self._tick, daemon=True)
+
+    def _tick(self):
+        self.count += 1
+
+    def reset(self):
+        self.count = 0
+'''
+    findings = run_source(src)
+    assert rules_of(findings) == ["GL704"]
+    assert findings[0].line == 13  # reported at the OUTSIDE site
+    assert "line 10" in findings[0].message  # with the thread-side witness
+
+
+def test_threads_gl704_suppression_with_justification():
+    src = '''
+import threading
+
+class Sampler:
+    def __init__(self):
+        self.count = 0  # single-writer: the tick thread
+        self._thread = threading.Thread(target=self._tick, daemon=True)
+
+    def _tick(self):
+        self.count += 1
+
+    def reset(self):
+        self.count = 0  # gomelint: disable=GL704 — called before start()
+'''
+    assert run_source(src) == []
+
+
+def test_threads_class_level_single_writer_claim():
+    """A `# single-writer` on the class line covers every attribute —
+    the whole-object claim (SeqTracker, HostSampler idiom)."""
+    src = '''
+class Tracker:  # single-writer: the observe() caller
+    def __init__(self):
+        self.seen = 0
+
+    def observe(self):
+        self.seen += 1
+
+TRACKER = Tracker()
+'''
+    assert run_source(src) == []
+
+
+def test_threads_guarded_contract_hands_off_to_gl4():
+    """A declared guard makes GL7xx stand down — and GL4xx take over:
+    the same off-lock mutation now fires GL401 instead of GL70x."""
+    src = '''
+import threading
+
+class Feed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = 0  # guarded by self._lock
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        self.events += 1
+'''
+    findings = run_source(src)
+    assert rules_of(findings) == ["GL401"]
+
+
 # --- whole-tree clean runs (the CI gate) ---------------------------------
 
 
