@@ -27,14 +27,20 @@ DIR_ENV = "GOME_LOG_DIR"
 
 def _default_log_dir() -> str:
     """Directory for the log file when the caller names none: the
-    GOME_LOG_DIR env override first; under pytest, the system tmp dir
-    (a test run must never litter the checkout — a stray order.log
-    reappeared in the repo root exactly this way); otherwise the CWD
-    (empty string — reference behavior, logger.go:14)."""
+    GOME_LOG_DIR env override first; under pytest, the system tmp dir;
+    when the CWD is a source checkout (a `.git` or `pyproject.toml`
+    marker), the system tmp dir again — the pytest guard alone kept
+    missing scripts/ entry points run from the repo root, and every such
+    run re-littered the checkout with a stray order.log; otherwise the
+    CWD (empty string — reference behavior, logger.go:14)."""
     d = os.environ.get(DIR_ENV)
     if d:
         return d
     if "PYTEST_CURRENT_TEST" in os.environ or "pytest" in sys.modules:
+        import tempfile
+
+        return tempfile.gettempdir()
+    if os.path.exists(".git") or os.path.exists("pyproject.toml"):
         import tempfile
 
         return tempfile.gettempdir()

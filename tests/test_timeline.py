@@ -4,6 +4,7 @@ ring bounds, probe isolation, the disabled-no-alloc hot-path guard, the
 compaction, and the GOME_LOG_DIR override — the ISSUE 6 surface."""
 
 import json
+import os
 import sys
 import urllib.request
 
@@ -369,3 +370,43 @@ def test_log_dir_default_is_tmp_under_pytest(tmp_path, monkeypatch):
     assert gl._default_log_dir() == tempfile.gettempdir()
     monkeypatch.setenv("GOME_LOG_DIR", str(tmp_path))
     assert gl._default_log_dir() == str(tmp_path)
+
+
+def test_log_dir_default_spares_source_checkouts(tmp_path):
+    """Outside pytest, a CWD that looks like a source checkout (`.git` or
+    `pyproject.toml` marker) still logs to the system tmp dir — scripts/
+    entry points run from the repo root kept re-littering the checkout
+    with order.log (round 9 root-cause; the pytest guard alone missed
+    them). A plain working directory keeps the reference's CWD behavior.
+    Subprocess: the in-process pytest branch would shadow the marker
+    check."""
+    import subprocess
+    import sys as _sys
+
+    prog = (
+        "import tempfile\n"
+        "from gome_tpu.utils.logging import _default_log_dir\n"
+        "d = _default_log_dir()\n"
+        "print('TMP' if d == tempfile.gettempdir() else 'CWD' if d == '' "
+        "else d)\n"
+    )
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("GOME_LOG_DIR", "PYTEST_CURRENT_TEST")
+    }
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+
+    def run_in(cwd):
+        return subprocess.run(
+            [_sys.executable, "-c", prog], cwd=cwd, env=env,
+            capture_output=True, text=True, timeout=60,
+        ).stdout.strip()
+
+    checkout = tmp_path / "checkout"
+    checkout.mkdir()
+    (checkout / "pyproject.toml").write_text("")
+    plain = tmp_path / "plain"
+    plain.mkdir()
+    assert run_in(checkout) == "TMP"
+    assert run_in(plain) == "CWD"
