@@ -21,6 +21,9 @@ engine's device entry points ONCE (a few seconds on CPU), feeding both
 the GL2xx dtype-envelope audit and the GL6xx buffer-donation audit from
 the same traced jaxprs — see gome_tpu/analysis/envelope.py,
 gome_tpu/analysis/donation.py, and ARCHITECTURE.md "Static analysis".
+CI's dedicated race job re-runs `--select GL7` (the thread-escape
+family, AST-only, so thread-discipline regressions are named by rule)
+before the scripts/race_drill.py lockset drill.
 """
 
 from __future__ import annotations
