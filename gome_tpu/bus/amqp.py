@@ -701,6 +701,15 @@ class AmqpQueue(Queue, _Waitable):
         with self._lock:
             return max(len(self._buffer), self._published)
 
+    def depth(self) -> int:
+        # Deliberately NO _sync(): this is the scrape-time lag gauge
+        # (bus.base.export_queue_metrics) and a /metrics scrape must
+        # never do a broker round trip. Reads the local arrival/publish
+        # view — momentarily stale until the next consume-path sync,
+        # never blocking.
+        with self._lock:
+            return max(len(self._buffer), self._published) - self._committed
+
     def committed(self) -> int:
         with self._lock:
             return self._committed
@@ -969,6 +978,13 @@ class SupervisedAmqpQueue(Queue):
             self._drain(sync=True)
             with self._state:
                 return max(len(self._log), self._published)
+
+    def depth(self) -> int:
+        # Scrape-time lag gauge: no _io lock, no drain — a wedged broker
+        # (or a reconnect in progress under _io) must not block /metrics.
+        # The local log/cursor view is momentarily stale, never torn.
+        with self._state:
+            return max(len(self._log), self._published) - self._committed
 
     def committed(self) -> int:
         with self._state:

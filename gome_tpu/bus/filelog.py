@@ -5,7 +5,11 @@ Format: length-prefixed records in one log file per queue
 sidecar ``<name>.offset`` holding the committed consumer offset as ASCII.
 Publishes fsync per append batch; commits rewrite the sidecar atomically
 (tmp + rename). A torn final record (crash mid-append) is detected on open
-and truncated away.
+and truncated away. Readers TAIL the log across processes: read_from/
+end_offset re-scan for records another process appended since the last
+look (single writer per queue; an incomplete tail record is the live
+writer mid-append and is skipped, not truncated) — the split
+gateway/consumer fleet topology runs on exactly this.
 
 This is the durability the reference lacks on its bus (non-durable queues +
 auto-ack, rabbitmq.go:64,102 — SURVEY §2.3.6): with a FileQueue, the order
@@ -43,13 +47,18 @@ class FileQueue(_Waitable, Queue):
         os.makedirs(os.path.dirname(self._log_path) or ".", exist_ok=True)
         # In-memory index: byte position of each record (offset -> filepos).
         self._positions: list[int] = []
-        self._scan_existing()
+        # Byte position one past the last fully-indexed record: the
+        # cross-process tail point (_refresh_locked resumes scanning
+        # here when ANOTHER process appended since we last looked).
+        self._scan_end = 0  # guarded by self._lock
+        with self._lock:
+            self._scan_existing_locked()
         self._f = open(self._log_path, "ab")
         self._committed = self._read_committed()
         self._init_wait()
 
     # -- recovery-time scan --------------------------------------------------
-    def _scan_existing(self) -> None:
+    def _scan_existing_locked(self) -> None:
         if not os.path.exists(self._log_path):
             return
         valid_end = 0
@@ -63,9 +72,37 @@ class FileQueue(_Waitable, Queue):
             self._positions.append(pos)
             pos += _LEN.size + n
             valid_end = pos
+        self._scan_end = valid_end
         if valid_end < len(data):
             with open(self._log_path, "ab") as f:
                 f.truncate(valid_end)
+
+    def _refresh_locked(self) -> None:
+        """Index records appended by ANOTHER process since our last look
+        (caller holds self._lock). The fleet topology runs one writer and
+        one reader process per queue over the same log file: the reader's
+        in-memory index must tail the writer's appends. Only complete
+        records are indexed — an incomplete tail is a record the live
+        writer is mid-append on, so (unlike the open-time scan) it is
+        left alone, never truncated. One stat per call when nothing
+        changed."""
+        try:
+            size = os.path.getsize(self._log_path)
+        except OSError:
+            return
+        if size <= self._scan_end:
+            return
+        with open(self._log_path, "rb") as f:
+            f.seek(self._scan_end)
+            data = f.read(size - self._scan_end)
+        pos = 0
+        while pos + _LEN.size <= len(data):
+            (n,) = _LEN.unpack_from(data, pos)
+            if pos + _LEN.size + n > len(data):
+                break  # writer mid-append; next refresh picks it up
+            self._positions.append(self._scan_end + pos)
+            pos += _LEN.size + n
+        self._scan_end += pos
 
     def _read_committed(self) -> int:
         """Parse the sidecar, surviving torn/empty/garbage contents.
@@ -90,7 +127,7 @@ class FileQueue(_Waitable, Queue):
             cut = FAULTS.fire("filelog.append")
             if cut:
                 # Torn append: persist a strict prefix of the record and
-                # die. _scan_existing truncates it away on the next open.
+                # die. _scan_existing_locked truncates it on the next open.
                 self._f.write(record[: cut % len(record)])
                 self._f.flush()
                 os.fsync(self._f.fileno())
@@ -101,12 +138,14 @@ class FileQueue(_Waitable, Queue):
             if self._fsync:
                 os.fsync(self._f.fileno())
             self._positions.append(pos)
+            self._scan_end = pos + len(record)
             off = len(self._positions) - 1
         self._notify_publish()
         return off
 
     def read_from(self, offset: int, max_n: int) -> list[Message]:
         with self._lock:
+            self._refresh_locked()
             end = min(len(self._positions), offset + max_n)
             if offset >= end:
                 return []
@@ -121,6 +160,7 @@ class FileQueue(_Waitable, Queue):
 
     def end_offset(self) -> int:
         with self._lock:
+            self._refresh_locked()
             return len(self._positions)
 
     def committed(self) -> int:
@@ -162,6 +202,7 @@ class FileQueue(_Waitable, Queue):
             self._f.truncate(pos)
             self._f.seek(pos)
             del self._positions[offset:]
+            self._scan_end = pos
 
     def _write_offset(self, offset: int) -> None:
         cut = FAULTS.fire("filelog.offset")

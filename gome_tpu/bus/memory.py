@@ -51,6 +51,12 @@ class MemoryQueue(_Waitable, Queue):
         with self._lock:
             return self._base + len(self._items)
 
+    def depth(self) -> int:
+        # One lock acquisition (the base-class default takes it twice —
+        # end then committed — and can interleave with a publish).
+        with self._lock:
+            return self._base + len(self._items) - self._committed
+
     def committed(self) -> int:
         with self._lock:
             return self._committed

@@ -267,6 +267,54 @@ class OpsConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet aggregation (gome_tpu.obs.fleet) — this process polls the
+    listed member processes' ops endpoints and serves the merged view
+    under its own ops server's /fleet. Disabled unless a `fleet:`
+    section appears in config.yaml (requires `ops:` too — the merged
+    view needs an HTTP surface to live on). `members` is a YAML list of
+    "name=http://host:port" strings (or {name: url} mappings)."""
+
+    enabled: bool = False
+    members: Any = ()  # "name=url" strings or {name: url} dicts
+    interval_s: float = 1.0  # poll period (seconds)
+    timeout_s: float = 2.0  # per-endpoint fetch timeout (seconds)
+
+    def __post_init__(self) -> None:
+        if self.interval_s <= 0:
+            raise ValueError(
+                f"fleet.interval_s must be positive, got {self.interval_s}"
+            )
+        if self.timeout_s <= 0:
+            raise ValueError(
+                f"fleet.timeout_s must be positive, got {self.timeout_s}"
+            )
+        if self.enabled and not self.members:
+            raise ValueError("fleet: enabled but no members listed")
+        self.member_map()  # malformed entries fail at load, not at poll
+
+    def member_map(self) -> dict[str, str]:
+        """{member name: base URL} from the YAML-friendly `members`
+        forms; names must be unique (they become the `proc` label)."""
+        out: dict[str, str] = {}
+        for entry in self.members or ():
+            if isinstance(entry, dict):
+                items = list(entry.items())
+            elif isinstance(entry, str) and "=" in entry:
+                items = [tuple(entry.split("=", 1))]
+            else:
+                raise ValueError(
+                    f"fleet.members entries must be 'name=url' or "
+                    f"{{name: url}}, got {entry!r}"
+                )
+            for name, url in items:
+                if name in out:
+                    raise ValueError(f"fleet.members: duplicate name {name!r}")
+                out[str(name)] = str(url)
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
 class SimConfig:
     """The on-device market simulator (gome_tpu.sim): Hawkes/Zipf flow
     parameters + environment geometry. New — the reference has no
@@ -395,6 +443,7 @@ class Config:
     engine: EngineConfig = EngineConfig()
     persist: PersistConfig = PersistConfig()
     ops: OpsConfig = OpsConfig()
+    fleet: FleetConfig = FleetConfig()
     sim: SimConfig = SimConfig()
     faults: FaultsConfig = FaultsConfig()
 
@@ -446,6 +495,9 @@ def load_config(path: str | None = None) -> Config:
     ops_raw = dict(raw.get("ops", {}) or {})
     if ops_raw:
         ops_raw.setdefault("enabled", True)
+    fleet_raw = dict(raw.get("fleet", {}) or {})
+    if fleet_raw:
+        fleet_raw.setdefault("enabled", True)
     sim_raw = dict(raw.get("sim", {}) or {})
     faults_raw = dict(raw.get("faults", {}) or {})
     if faults_raw:
@@ -454,7 +506,7 @@ def load_config(path: str | None = None) -> Config:
 
     known = {
         "grpc", "redis", "rabbitmq", "bus", "gomengine", "engine",
-        "persist", "ops", "sim", "faults",
+        "persist", "ops", "fleet", "sim", "faults",
     }
     unknown = set(raw) - known
     if unknown:
@@ -467,6 +519,7 @@ def load_config(path: str | None = None) -> Config:
         engine=_build(EngineConfig, engine_raw, "engine"),
         persist=_build(PersistConfig, persist_raw, "persist"),
         ops=_build(OpsConfig, ops_raw, "ops"),
+        fleet=_build(FleetConfig, fleet_raw, "fleet"),
         sim=_build(SimConfig, sim_raw, "sim"),
         faults=_build(FaultsConfig, faults_raw, "faults"),
     )

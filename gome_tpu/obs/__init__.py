@@ -42,6 +42,16 @@ cooperating pieces:
     the ops ``/hostprof`` endpoint + ``gome_hostprof_*`` gauges.
     ``HOSTPROF`` follows the same disabled-singleton hot-path contract
     (the gateway calls ``note_admit`` per accepted order).
+  * ``fleet`` — the PROCESS axis (ISSUE 13): a :class:`FleetAggregator`
+    that polls N member processes' ops endpoints and serves the merged
+    view (``/fleet``) — counters summed, same-bucket histograms merged,
+    gauges unioned under a ``proc`` label (the exposition parse/merge
+    engine lives in ``utils.metrics``) — plus cross-process trace
+    stitching (journeys joined by trace id across gateway/consumer
+    processes, clock offset estimated from the ``"<id>@<t>"`` wire
+    contexts). ``FLEET`` follows the same disabled-singleton hot-path
+    contract; ``scripts/fleet_drill.py`` publishes ``FLEET_r01.json``
+    from a real 2-gateway x 2-consumer subprocess fleet.
   * ``scripts/perf_ratchet.py`` — gates the deterministic analytic
     metrics (flops/order, bytes/order, peak HBM, compile count) against
     the committed ``PERF_BASELINE.json`` in CI.
@@ -72,13 +82,14 @@ __all__ = [
     "HostSampler",
     "hostprof",
     "costmodel",
+    "fleet",
     "live",
     "profiler",
 ]
 
 
 def __getattr__(name):
-    if name in ("costmodel", "live", "profiler"):
+    if name in ("costmodel", "fleet", "live", "profiler"):
         import importlib
 
         mod = importlib.import_module(f".{name}", __name__)

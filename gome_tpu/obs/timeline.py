@@ -380,6 +380,31 @@ def service_timeline(service, sampler: TimelineSampler | None = None):
             lambda: {"order_backlog": int(q.end_offset() - q.committed())},
         )
 
+    bus = getattr(service, "bus", None)
+    bus_queues = [
+        bq
+        for bq in (
+            getattr(bus, "order_queue", None),
+            getattr(bus, "match_queue", None),
+        )
+        if bq is not None and hasattr(bq, "depth")
+    ]
+    if bus_queues:
+        # Per-queue depth/lag (Queue.depth — local-state read, no broker
+        # I/O even on amqp): the per-partition fan-in telemetry the fleet
+        # verdicts read. The "queue" probe above stays as-is — the soak
+        # verdicts key on its order_backlog field.
+        def bus_probe():
+            return {
+                bq.name: {
+                    "depth": int(bq.depth()),
+                    "committed": int(bq.committed()),
+                }
+                for bq in bus_queues
+            }
+
+        tl.register("bus", bus_probe)
+
     gw = getattr(service, "gateway", None)
     batcher = getattr(gw, "batcher", None) or getattr(gw, "_batcher", None)
     if batcher is not None:

@@ -48,6 +48,14 @@ class EngineService:
                 len(self.config.faults.fault_plan().faults),
             )
         self.bus = make_bus(self.config.bus)
+        from ..bus.base import export_queue_metrics
+
+        # Per-queue depth/lag gauges (gome_bus_depth{queue=...}): scrape-
+        # time reads of local queue state, registered for both queues on
+        # every backend — the per-partition fan-in telemetry obs.fleet
+        # aggregates.
+        export_queue_metrics(self.bus.order_queue)
+        export_queue_metrics(self.bus.match_queue)
         e = self.config.engine
         mesh = None
         if e.mesh_devices:
@@ -180,6 +188,19 @@ class EngineService:
                     hz=self.config.ops.hostprof_hz,
                     keep_n=self.config.ops.hostprof_keep,
                 )
+            if self.config.fleet.enabled:
+                # Arm the fleet aggregator (gome_tpu.obs.fleet): this
+                # process polls the listed members' ops endpoints and
+                # serves the merged view under its own /fleet. The
+                # polling thread runs only while the service is
+                # start()ed.
+                from ..obs.fleet import FLEET
+
+                FLEET.install(
+                    self.config.fleet.member_map(),
+                    interval_s=self.config.fleet.interval_s,
+                    timeout_s=self.config.fleet.timeout_s,
+                )
             self.ops = OpsServer(
                 self, host=self.config.ops.host, port=self.config.ops.port
             )
@@ -210,6 +231,10 @@ class EngineService:
                 from ..obs.hostprof import HOSTPROF
 
                 HOSTPROF.start()
+            if self.config.fleet.enabled:
+                from ..obs.fleet import FLEET
+
+                FLEET.start()
         return self
 
     def stop(self):
@@ -228,6 +253,10 @@ class EngineService:
                 from ..obs.hostprof import HOSTPROF
 
                 HOSTPROF.stop()
+            if self.config.fleet.enabled:
+                from ..obs.fleet import FLEET
+
+                FLEET.stop()
 
     def wait(self):
         if self._server is not None:

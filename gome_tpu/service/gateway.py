@@ -298,6 +298,9 @@ def serve_gateway(
     bound = server.add_insecure_port(addr)
     if bound == 0:
         raise RuntimeError(f"failed to bind gRPC listener on {addr}")
+    # Port-0 callers (tests, the fleet drill's subprocess workers) need
+    # the OS-assigned port; grpc.Server has no accessor for it.
+    server.bound_port = bound
     server.start()
     log.info("gateway serving on %s:%d", config.grpc.host, bound)
     return server

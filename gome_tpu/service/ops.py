@@ -23,6 +23,9 @@ one stdlib ThreadingHTTPServer, no dependencies, curl-able:
     curl localhost:9109/durability  # snapshot cadence, recovery state,
                                     # matchfeed exactly-once tracker,
                                     # fault-injection report
+    curl localhost:9109/fleet       # merged N-process view (obs.fleet):
+                                    # per-member health, summed counters,
+                                    # fleet-wide seq audit
 
 Enabled by an `ops:` section in config.yaml (port, host) or by
 constructing OpsServer directly around any EngineService.
@@ -177,6 +180,16 @@ class OpsServer:
         payload["queues"] = queues
         return payload
 
+    def fleet_payload(self) -> dict:
+        """The /fleet JSON document: the fleet aggregator's merged view
+        (gome_tpu.obs.fleet.FLEET) — per-member health + degraded
+        rollup, the merged metric exposition with per-family totals,
+        the fleet-wide matchfeed seq audit, and member timeline tails.
+        ``{"enabled": false}`` while no member map is installed."""
+        from ..obs.fleet import FLEET
+
+        return FLEET.payload()
+
     def hostprof_payload(self, run_drill: bool = False) -> dict:
         """The /hostprof JSON document: the host-CPU sampling profiler
         (gome_tpu.obs.hostprof.HOSTPROF) — the live wall-profile stage
@@ -267,13 +280,30 @@ class OpsServer:
                             ops.durability_payload(), default=str
                         ).encode()
                         self._send(200, body, "application/json")
+                    elif self.path.split("?")[0] == "/fleet":
+                        body = json.dumps(
+                            ops.fleet_payload(), default=str
+                        ).encode()
+                        self._send(200, body, "application/json")
                     elif self.path.split("?")[0] == "/trace":
+                        query = (self.path.split("?", 1)[1:] or [""])[0]
                         rec = ops.tracer.recorder
-                        dump = (
-                            rec.chrome_trace()
-                            if rec is not None
-                            else {"traceEvents": []}
-                        )
+                        if "format=journeys" in query:
+                            # The fleet aggregator's stitch feed: raw
+                            # journeys (open ones included — a gateway
+                            # process never completes its half) instead
+                            # of the Chrome-trace render.
+                            dump = (
+                                rec.export()
+                                if rec is not None
+                                else {"pid": None, "journeys": []}
+                            )
+                        else:
+                            dump = (
+                                rec.chrome_trace()
+                                if rec is not None
+                                else {"traceEvents": []}
+                            )
                         body = json.dumps(dump).encode()
                         self._send(200, body, "application/json")
                     else:
@@ -292,7 +322,8 @@ class OpsServer:
         )
         self._thread.start()
         log.info("ops endpoint up on %s:%d (/metrics, /healthz, /trace, "
-                 "/cost, /timeline, /profile, /hostprof, /durability)",
+                 "/cost, /timeline, /profile, /hostprof, /durability, "
+                 "/fleet)",
                  self.host, self.port)
         return self
 

@@ -16,6 +16,7 @@ registry forces. A name is either flat or a family, never both.
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 import time
 
@@ -372,3 +373,285 @@ class _Timer:
 
 # Process-global default registry (modules grab metrics from here).
 REGISTRY = Registry()
+
+
+# -- exposition parse + merge (fleet federation) -----------------------------
+#
+# The FleetAggregator (gome_tpu.obs.fleet) scrapes N member processes'
+# /metrics text and serves ONE merged exposition: counters sum, same-bucket
+# histograms merge, gauges union under a new `proc` label. The parser below
+# reads exactly the dialect Registry.render() writes (HELP line, TYPE line,
+# sample lines with sorted labels and `le` last), so parse -> render is
+# byte-identical — the lossless-merge contract tests pin.
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (.+)$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+class Sample:
+    """One exposition sample line, structured. `labels` preserves the
+    source order (the registry writes sorted keys with `le` appended
+    last, so re-rendering in insertion order reproduces the line);
+    `value_str` keeps the exact source text so a parse -> render round
+    trip never reformats numbers (`3` stays `3`, `0.0` stays `0.0`)."""
+
+    __slots__ = ("name", "labels", "value_str")
+
+    def __init__(self, name: str, labels: dict, value_str: str):
+        self.name = name
+        self.labels = labels
+        self.value_str = value_str
+
+    @property
+    def value(self) -> float:
+        return float(self.value_str)
+
+    def line(self) -> str:
+        if not self.labels:
+            return f"{self.name} {self.value_str}"
+        inner = ",".join(f'{k}="{v}"' for k, v in self.labels.items())
+        return f"{self.name}{{{inner}}} {self.value_str}"
+
+
+class ParsedFamily:
+    """One metric family parsed back from exposition text: the HELP/TYPE
+    header plus its sample lines (for histograms that includes the
+    `_bucket`/`_sum`/`_count` suffixed samples)."""
+
+    def __init__(self, name: str, help: str = "", typ: str = "untyped"):
+        self.name = name
+        self.help = help
+        self.typ = typ
+        self.samples: list[Sample] = []
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.typ}",
+        ]
+        lines.extend(s.line() for s in self.samples)
+        return "\n".join(lines)
+
+
+def parse_exposition(text: str) -> dict[str, ParsedFamily]:
+    """Parse Prometheus text exposition into {family name: ParsedFamily},
+    preserving family and sample order. Sample lines attach to the most
+    recent HELP/TYPE header (which is how histogram `_bucket` suffixes
+    stay with their base family); a sample before any header is a format
+    error."""
+    families: dict[str, ParsedFamily] = {}
+    current: ParsedFamily | None = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            name = parts[2]
+            fam = families.get(name)
+            if fam is None:
+                fam = families[name] = ParsedFamily(name)
+            fam.help = parts[3] if len(parts) > 3 else ""
+            current = fam
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            name = parts[2]
+            fam = families.get(name)
+            if fam is None:
+                fam = families[name] = ParsedFamily(name)
+            fam.typ = parts[3] if len(parts) > 3 else "untyped"
+            current = fam
+            continue
+        if line.startswith("#"):
+            continue  # comment — not part of the registry dialect
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line {lineno}: {line!r}")
+        if current is None:
+            raise ValueError(
+                f"exposition line {lineno} has no preceding HELP/TYPE "
+                f"header: {line!r}"
+            )
+        name, labelstr, value_str = m.groups()
+        labels = (
+            dict(_LABEL_RE.findall(labelstr)) if labelstr else {}
+        )
+        current.samples.append(Sample(name, labels, value_str))
+    return families
+
+
+def render_exposition(families: dict[str, ParsedFamily]) -> str:
+    """Re-render parsed families in order — the inverse of
+    parse_exposition and byte-identical to the Registry.render() dialect."""
+    return "\n".join(f.render() for f in families.values()) + "\n"
+
+
+def _fmt_merged(total: float, value_strs: list[str]) -> str:
+    """Render a merged numeric total in the narrowest format the inputs
+    used: all-int inputs stay int (`3`), any float input renders via
+    repr (`0.0`) — so merged counters keep the counter dialect."""
+    if all(re.fullmatch(r"-?\d+", v) for v in value_strs):
+        return str(int(total))
+    return repr(float(total))
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _merge_counter(name: str, per_member: list[ParsedFamily]) -> ParsedFamily:
+    out = ParsedFamily(name, per_member[0].help, "counter")
+    order: list[tuple] = []
+    acc: dict[tuple, tuple[str, dict, float, list]] = {}
+    for fam in per_member:
+        for s in fam.samples:
+            key = (s.name, _label_key(s.labels))
+            if key not in acc:
+                order.append(key)
+                acc[key] = (s.name, s.labels, s.value, [s.value_str])
+            else:
+                n, lb, tot, strs = acc[key]
+                acc[key] = (n, lb, tot + s.value, strs + [s.value_str])
+    for key in order:
+        n, lb, tot, strs = acc[key]
+        out.samples.append(Sample(n, lb, _fmt_merged(tot, strs)))
+    return out
+
+
+def _merge_gauge(
+    name: str, members: list[tuple[str, ParsedFamily]]
+) -> ParsedFamily:
+    """Gauges don't sum meaningfully across processes (each is a local
+    reading), so member samples union under a new `proc` label — labels
+    re-sorted so `proc` lands in deterministic exposition position."""
+    out = ParsedFamily(name, members[0][1].help, members[0][1].typ)
+    for proc, fam in members:
+        for s in fam.samples:
+            labels = dict(sorted({**s.labels, "proc": proc}.items()))
+            out.samples.append(Sample(s.name, labels, s.value_str))
+    return out
+
+
+def _merge_histogram(
+    name: str, per_member: list[ParsedFamily]
+) -> ParsedFamily:
+    """Merge same-bucket histograms: per base label set (labels minus
+    `le`), the cumulative bucket counts, `_sum`, and `_count` sum across
+    members. Members whose `le` sequences differ can't merge losslessly —
+    that's a hard ValueError, not a silent drop."""
+    out = ParsedFamily(name, per_member[0].help, "histogram")
+    # base label key -> {"les": [...], "buckets": {le: total},
+    #                    "sum": (tot, strs), "count": (tot, strs)}
+    order: list[tuple] = []
+    acc: dict[tuple, dict] = {}
+    for fam in per_member:
+        per_base_les: dict[tuple, list[str]] = {}
+        for s in fam.samples:
+            if s.name == f"{name}_bucket":
+                base = {k: v for k, v in s.labels.items() if k != "le"}
+                key = _label_key(base)
+                per_base_les.setdefault(key, []).append(s.labels["le"])
+                ent = acc.get(key)
+                if ent is None:
+                    order.append(key)
+                    ent = acc[key] = {
+                        "base": base, "les": None, "buckets": {},
+                        "sum": (0.0, []), "count": (0, []),
+                    }
+                le = s.labels["le"]
+                ent["buckets"][le] = ent["buckets"].get(le, 0) + s.value
+            elif s.name in (f"{name}_sum", f"{name}_count"):
+                key = _label_key(s.labels)
+                ent = acc.get(key)
+                if ent is None:
+                    order.append(key)
+                    ent = acc[key] = {
+                        "base": s.labels, "les": None, "buckets": {},
+                        "sum": (0.0, []), "count": (0, []),
+                    }
+                which = "sum" if s.name.endswith("_sum") else "count"
+                tot, strs = ent[which]
+                ent[which] = (tot + s.value, strs + [s.value_str])
+            else:
+                raise ValueError(
+                    f"histogram family {name!r} has unexpected sample "
+                    f"{s.name!r}"
+                )
+        for key, les in per_base_les.items():
+            ent = acc[key]
+            if ent["les"] is None:
+                ent["les"] = les
+            elif ent["les"] != les:
+                raise ValueError(
+                    f"histogram {name!r} bucket mismatch across members: "
+                    f"{ent['les']} vs {les} — same-bucket histograms only"
+                )
+    for key in order:
+        ent = acc[key]
+        base = ent["base"]
+        for le in ent["les"] or []:
+            labels = dict(base)
+            labels["le"] = le  # after the sorted base labels, registry-style
+            out.samples.append(
+                Sample(f"{name}_bucket", labels, str(int(ent["buckets"][le])))
+            )
+        tot, strs = ent["sum"]
+        out.samples.append(Sample(f"{name}_sum", dict(base), _fmt_merged(tot, strs)))
+        tot, strs = ent["count"]
+        out.samples.append(
+            Sample(f"{name}_count", dict(base), _fmt_merged(tot, strs))
+        )
+    return out
+
+
+def merge_expositions(
+    members: dict[str, str | dict]
+) -> dict[str, ParsedFamily]:
+    """Merge N member expositions into one fleet view: counters SUM per
+    label set, histograms merge per base label set (identical bucket
+    sequences required), gauges (and untyped families) UNION under a new
+    `proc="<member>"` label. `members` maps member name -> exposition
+    text (or an already-parsed family dict). Conflicting TYPEs for one
+    family name across members raise ValueError — a lossy merge is a
+    bug, never a best-effort."""
+    parsed: list[tuple[str, dict[str, ParsedFamily]]] = [
+        (proc, parse_exposition(fams) if isinstance(fams, str) else fams)
+        for proc, fams in members.items()
+    ]
+    name_order: list[str] = []
+    seen: set[str] = set()
+    for _, fams in parsed:
+        for name in fams:
+            if name not in seen:
+                seen.add(name)
+                name_order.append(name)
+    out: dict[str, ParsedFamily] = {}
+    for name in name_order:
+        present = [(proc, fams[name]) for proc, fams in parsed if name in fams]
+        typs = {fam.typ for _, fam in present}
+        if len(typs) > 1:
+            raise ValueError(
+                f"family {name!r} has conflicting types across members: "
+                f"{sorted(typs)}"
+            )
+        typ = typs.pop()
+        if typ == "counter":
+            out[name] = _merge_counter(name, [fam for _, fam in present])
+        elif typ == "histogram":
+            out[name] = _merge_histogram(name, [fam for _, fam in present])
+        else:
+            out[name] = _merge_gauge(name, present)
+    return out
+
+
+def family_total(fam: ParsedFamily) -> float:
+    """One scalar per family for the lossless-merge audit: histograms
+    total their `_count` samples, counters/gauges total every sample.
+    sum(member totals) == merged total is the invariant tests assert."""
+    if fam.typ == "histogram":
+        return sum(
+            s.value for s in fam.samples if s.name == f"{fam.name}_count"
+        )
+    return sum(s.value for s in fam.samples)

@@ -152,6 +152,48 @@ class FlightRecorder:
         seen = {id(j) for j in done}
         return done + [j for j in slow if id(j) not in seen]
 
+    def export(self, include_open: bool = True) -> dict:
+        """Wire export for cross-process stitching (obs.fleet): every
+        journey this process knows, tagged with the recorder's pid. Open
+        journeys are included by default — a gateway process never sees
+        the consumer-side `complete()`, so its half of every journey
+        lives in `_open` forever; the aggregator joins the halves by
+        trace id. Spans serialize as [stage, t0, t1, meta] lists (JSON
+        round-trip keeps them list-shaped on the far side)."""
+        out = []
+        for j in self.journeys():
+            out.append(
+                {
+                    "trace_id": j["trace_id"],
+                    "spans": [list(s) for s in j["spans"]],
+                    "start": j["start"],
+                    "end": j["end"],
+                    "duration_s": j["duration_s"],
+                    "open": False,
+                }
+            )
+        if include_open:
+            with self._lock:
+                open_items = [
+                    (tid, list(spans)) for tid, spans in self._open.items()
+                ]
+            for tid, spans in open_items:
+                if not spans:
+                    continue
+                start = min(s[1] for s in spans)
+                end = max(s[2] for s in spans)
+                out.append(
+                    {
+                        "trace_id": tid,
+                        "spans": [list(s) for s in spans],
+                        "start": start,
+                        "end": end,
+                        "duration_s": end - start,
+                        "open": True,
+                    }
+                )
+        return {"pid": os.getpid(), "journeys": out}
+
     def journey(self, trace_id: str) -> dict | None:
         for j in self.journeys():
             if j["trace_id"] == trace_id:

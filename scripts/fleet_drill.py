@@ -1,0 +1,757 @@
+#!/usr/bin/env python
+"""Fleet drill: a real 2-gateway x 2-consumer fleet with a machine-checked
+aggregate-observability verdict.
+
+The question this script answers: when the engine is deployed as a
+PARTITIONED fleet (disjoint symbol sets, one gateway + one consumer per
+partition, split processes sharing a file bus + RESP marker store — the
+reference's own three-process shape, scaled out sideways), can one
+aggregator process (obs.fleet.FLEET) see the whole thing: merged
+/metrics that are lossless over the members, a health/degradation
+rollup that stays green for the entire run, a fleet-wide exactly-once
+seq audit, and order journeys STITCHED across the gateway/consumer
+process boundary into one timeline?
+
+Topology (parent drives everything; 5 children):
+
+    parent                              children (this script, --worker)
+    ------                              -----------------------------
+    record sim GCO frames               respserver (RESP marker store)
+    decode -> per-order requests        gw0, gw1: OrderGateway + gRPC
+    route by crc32(symbol) % 2              + ops server (file bus p{i})
+    drive both partitions over gRPC     c0, c1: full EngineService
+    FLEET polls all 4 ops servers           (consumer + matchfeed + ops)
+    drain via /durability; stitch
+    journeys; audit seqs; verdict
+
+Partitioning is CONFIG-LEVEL: the driver routes each order's symbol with
+a stable hash to one of two disjoint (bus dir, queue, store namespace)
+partitions. No consistent-hashing subsystem exists or is implied — the
+point is that N independent single-partition deployments plus the
+aggregator ARE a fleet.
+
+The verdict JSON (committed as FLEET_r01.json, pinned by
+tests/test_fleet.py) records the aggregate throughput table (per-proc
+orders/sec + getrusage, fleet total, stitched end-to-end latency
+percentiles), the health rollup, the merge-losslessness proof, the
+fleet-wide seq audit, and a pass/fail per check. CI runs this with
+``--seconds 30`` and fails the build on any breach.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Must be set before anything imports jax (workers inherit it too).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SCHEMA = "gome-fleet-verdict-v1"
+
+N_PARTITIONS = 2
+
+# Worker geometry: small enough to compile in seconds on CPU, matched to
+# the sim flow below (n_slots >= n_lanes, max_t >= t_bins).
+N_LANES = 16
+T_BINS = 8
+
+
+def partition_of(symbol: str) -> int:
+    """Stable symbol -> partition routing (driver-side config, not a
+    subsystem): every process in the fleet that needs it can recompute
+    it from the symbol alone."""
+    return zlib.crc32(symbol.encode()) % N_PARTITIONS
+
+
+def rusage_self() -> dict:
+    import resource
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return {
+        "utime_s": round(ru.ru_utime, 4),
+        "stime_s": round(ru.ru_stime, 4),
+        "maxrss_kb": ru.ru_maxrss,
+    }
+
+
+def write_json(path: str, doc: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+# -- workers -----------------------------------------------------------------
+#
+# Protocol (both roles): print one "READY ops=<port> grpc=<port>" line on
+# stdout once serving, then block reading stdin; any line (or EOF) is the
+# stop signal. On stop: write the result JSON to --result, tear down,
+# exit 0.
+
+
+def _await_stop() -> None:
+    try:
+        sys.stdin.readline()
+    except Exception:
+        pass
+
+
+def run_gateway_worker(args) -> int:
+    """One partition's front door: OrderGateway over the partition's file
+    bus, pre-pool marks in the shared RESP store, gRPC listener, and its
+    OWN ops server (/metrics + /trace + /timeline) for the aggregator to
+    scrape. No engine, no consumer — journeys opened here complete in
+    the consumer process; stitching joins them."""
+    from gome_tpu.bus import make_bus
+    from gome_tpu.bus.base import export_queue_metrics
+    from gome_tpu.config import BusConfig, Config, GrpcConfig
+    from gome_tpu.engine.prepool import RespPrePool, make_marker
+    from gome_tpu.obs.timeline import TIMELINE
+    from gome_tpu.persist.resp import RespClient
+    from gome_tpu.service.gateway import OrderGateway, serve_gateway
+    from gome_tpu.service.ops import OpsServer
+    from gome_tpu.utils.trace import TRACER, FlightRecorder
+
+    bus = make_bus(
+        BusConfig(backend="file", dir=args.bus_dir, match_wire="frame")
+    )
+    export_queue_metrics(bus.order_queue)
+    export_queue_metrics(bus.match_queue)
+    # Gateway-side journeys never complete locally (the consumer closes
+    # them): a deep open ring keeps the tail of the run joinable.
+    TRACER.install(FlightRecorder(keep_n=512, max_open=8192))
+    TIMELINE.install(interval_s=0.25, keep_n=256)
+    pool = RespPrePool(RespClient(port=args.resp_port))
+    gateway = OrderGateway(bus, accuracy=0, mark=make_marker(pool))
+    server = serve_gateway(
+        gateway, Config(grpc=GrpcConfig(host="127.0.0.1", port=0))
+    )
+    ops = OpsServer(service=None, host="127.0.0.1", port=0)
+    ops.start()
+    TIMELINE.start()
+    print(f"READY ops={ops.port} grpc={server.bound_port}", flush=True)
+    _await_stop()
+    result = {
+        "role": "gateway",
+        "partition": args.partition,
+        "published": {
+            "doOrder": bus.order_queue.end_offset(),
+        },
+        "rusage": rusage_self(),
+    }
+    write_json(args.result, result)
+    server.stop(grace=1).wait()
+    TIMELINE.stop()
+    ops.stop()
+    return 0
+
+
+def run_consumer_worker(args) -> int:
+    """One partition's engine half: a full EngineService (consumer +
+    matchfeed + ops server) over the partition's file bus, marker store
+    attached so admission consumes the gateway's pre-pool marks."""
+    from gome_tpu.config import (
+        BusConfig, Config, EngineConfig, GrpcConfig, OpsConfig, StoreConfig,
+    )
+    from gome_tpu.service.app import EngineService
+
+    # Per-event match logging is operator chrome; at drill rates it
+    # floods the parent's console.
+    import logging
+
+    logging.getLogger("gome_tpu.matchfeed").setLevel(logging.WARNING)
+    svc = EngineService(Config(
+        grpc=GrpcConfig(host="127.0.0.1", port=0),
+        bus=BusConfig(backend="file", dir=args.bus_dir, match_wire="frame"),
+        engine=EngineConfig(
+            accuracy=0, cap=64, max_fills=8, n_slots=N_LANES, max_t=T_BINS,
+            dtype="int64", kernel="scan",
+        ),
+        store=StoreConfig(enabled=True, host="127.0.0.1", port=args.resp_port),
+        ops=OpsConfig(
+            enabled=True, host="127.0.0.1", port=0,
+            trace=True, trace_keep=4096,
+            timeline=True, timeline_interval_s=0.25,
+            cost=False, profile=False, hostprof=False,
+        ),
+    ))
+    svc.start()
+    print(f"READY ops={svc.ops.port} grpc=0", flush=True)
+    _await_stop()
+    oq, mq = svc.bus.order_queue, svc.bus.match_queue
+    result = {
+        "role": "consumer",
+        "partition": args.partition,
+        "orders_consumed": oq.committed(),
+        "feed": svc.feed.seq_state(),
+        "oq": {"end": oq.end_offset(), "committed": oq.committed()},
+        "mq": {"end": mq.end_offset(), "committed": mq.committed()},
+        "rusage": rusage_self(),
+    }
+    write_json(args.result, result)
+    svc.stop()
+    return 0
+
+
+# -- parent ------------------------------------------------------------------
+
+
+def record_sim_frames(seed: int, n_steps: int) -> list[bytes]:
+    from gome_tpu.sim.env import EnvConfig
+    from gome_tpu.sim.flow import FlowConfig
+    from gome_tpu.sim.replay import record_frames
+
+    cfg = EnvConfig(flow=FlowConfig(
+        n_lanes=N_LANES, t_bins=T_BINS, dt=0.07,
+        submit_rate=3.0, cancel_rate=1.5, market_rate=1.0,
+    ))
+    return record_frames(cfg, seed, n_steps)
+
+
+def requests_from_frames(frames: list[bytes]) -> list[list]:
+    """Decode recorded GCO frames into per-partition gRPC request
+    streams: [(is_cancel, OrderRequest), ...] per partition, global
+    arrival order preserved inside each partition (the ADD-before-DEL
+    sequencing contract only spans one symbol, and a symbol maps to
+    exactly one partition)."""
+    from gome_tpu.api import order_pb2 as pb
+    from gome_tpu.bus.colwire import decode_order_frame
+
+    parts: list[list] = [[] for _ in range(N_PARTITIONS)]
+    for fr in frames:
+        cols = decode_order_frame(fr)
+        symbols, uuids = cols["symbols"], cols["uuids"]
+        for i in range(cols["n"]):
+            action = int(cols["action"][i])
+            if action == 0:  # NOP padding never reaches the wire
+                continue
+            symbol = symbols[int(cols["symbol_idx"][i])]
+            req = pb.OrderRequest(
+                uuid=uuids[int(cols["uuid_idx"][i])],
+                oid=cols["oids"][i].decode(),
+                symbol=symbol,
+                transaction=int(cols["side"][i]),
+                price=float(int(cols["price"][i])),
+                volume=float(int(cols["volume"][i])),
+                kind=int(cols["kind"][i]),
+            )
+            parts[partition_of(symbol)].append((action == 2, req))
+    return parts
+
+
+def drive_partition(target: str, reqs: list, out: dict) -> None:
+    """Serial gRPC drive of one partition's gateway (the per-order front
+    door — round-trip latency included, like clients.doorder). Tallies
+    response codes; any transport error is recorded, not raised."""
+    import grpc
+
+    from gome_tpu.api.service import OrderStub
+
+    codes: dict[int, int] = {}
+    t0 = time.perf_counter()
+    try:
+        with grpc.insecure_channel(target) as channel:
+            stub = OrderStub(channel)
+            for is_cancel, req in reqs:
+                rpc = stub.DeleteOrder if is_cancel else stub.DoOrder
+                resp = rpc(req, timeout=10)
+                codes[resp.code] = codes.get(resp.code, 0) + 1
+    except grpc.RpcError as exc:  # pragma: no cover - transport breach
+        out["transport_error"] = str(exc)
+    out["codes"] = {str(k): v for k, v in sorted(codes.items())}
+    out["sent"] = len(reqs)
+    out["wall_s"] = time.perf_counter() - t0
+
+
+def fetch_json(url: str, timeout_s: float = 2.0) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
+
+
+def await_drained(ops_url: str, expect_orders: int, timeout_s: float) -> bool:
+    """Poll one consumer's /durability until its order queue has consumed
+    everything the gateway published and the match feed has caught up
+    with the match queue."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            doc = fetch_json(ops_url + "/durability")
+            queues = doc.get("queues") or {}
+            oq = queues.get("order_queue") or {}
+            mq = queues.get("match_queue") or {}
+            if (
+                oq.get("committed", -1) >= expect_orders
+                and mq.get("committed", -1) >= mq.get("end", 0)
+            ):
+                return True
+        except Exception:
+            pass
+        time.sleep(0.1)
+    return False
+
+
+def read_match_seqs(bus_dir: str) -> tuple[int, list[int]]:
+    """The durable queue-level record for one partition: event count and
+    the raw seq sequence for the exactly-once audit."""
+    from gome_tpu.bus.colwire import decode_event_frame
+    from gome_tpu.bus.filelog import FileQueue
+
+    q = FileQueue("matchOrder", os.path.join(bus_dir, "matchOrder"))
+    n_events = 0
+    seqs: list[int] = []
+    for m in q.read_from(0, q.end_offset()):
+        batch = decode_event_frame(m.body)
+        for r in batch.to_results():
+            n_events += 1
+            if r.seq is not None:
+                seqs.append(r.seq)
+    q.close()
+    return n_events, seqs
+
+
+def audit_seqs(seqs: list[int]) -> dict:
+    from gome_tpu.service.matchfeed import SeqTracker
+
+    tracker = SeqTracker(first_seq=0)
+    for s in seqs:
+        tracker.observe(s)
+    return tracker.state()
+
+
+def pctl(xs: list[float], p: float) -> float | None:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(p / 100.0 * (len(xs) - 1))))]
+
+
+class Worker:
+    """One child process with the READY/stdin-stop protocol."""
+
+    def __init__(self, name: str, cmd: list[str]):
+        self.name = name
+        self.proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True
+        )
+        self.ports: dict[str, int] = {}
+
+    def await_ready(self, timeout_s: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        line = ""
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                raise RuntimeError(
+                    f"{self.name} exited before READY "
+                    f"(rc={self.proc.poll()})"
+                )
+            if line.startswith("READY "):
+                for tok in line.split()[1:]:
+                    key, _, val = tok.partition("=")
+                    self.ports[key] = int(val)
+                return
+        raise RuntimeError(f"{self.name} never became READY: {line!r}")
+
+    def stop(self, timeout_s: float = 60.0) -> int:
+        try:
+            self.proc.stdin.write("STOP\n")
+            self.proc.stdin.flush()
+        except (BrokenPipeError, OSError):
+            pass
+        try:
+            return self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            return self.proc.wait(timeout=10)
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+
+def start_respserver(work: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gome_tpu.persist.respserver", "--port", "0"],
+        stdout=subprocess.PIPE, text=True, cwd=work, env=env,
+    )
+    line = proc.stdout.readline()
+    if not line.startswith("READY "):
+        proc.kill()
+        raise RuntimeError(f"respserver handshake failed: {line!r}")
+    proc.resp_port = int(line.split()[1])
+    return proc
+
+
+def run_parent(args) -> int:
+    import tempfile
+
+    from gome_tpu.obs.fleet import FLEET, stitch_journeys
+    from gome_tpu.utils.metrics import (
+        family_total, merge_expositions, parse_exposition, render_exposition,
+    )
+
+    work = args.workdir or tempfile.mkdtemp(prefix="gome-fleet-")
+    os.makedirs(work, exist_ok=True)
+    n_steps = max(32, min(480, args.seconds * 8))
+    print(f"fleet: recording {n_steps} sim steps (seed {args.seed})...")
+    frames = record_sim_frames(args.seed, n_steps)
+    parts = requests_from_frames(frames)
+    n_orders = sum(len(p) for p in parts)
+    sym_counts = [
+        len({r.symbol for _, r in p}) for p in parts
+    ]
+    print(
+        f"fleet: {len(frames)} frames / {n_orders} orders -> "
+        f"partitions {[len(p) for p in parts]} "
+        f"(symbols {sym_counts}) in {work}"
+    )
+
+    resp = None
+    workers: dict[str, Worker] = {}
+    try:
+        resp = start_respserver(work)
+        bus_dirs = []
+        for i in range(N_PARTITIONS):
+            bus_dir = os.path.join(work, f"p{i}", "bus")
+            os.makedirs(bus_dir, exist_ok=True)
+            bus_dirs.append(bus_dir)
+            for role in ("consumer", "gateway"):
+                name = ("c" if role == "consumer" else "gw") + str(i)
+                workers[name] = Worker(name, [
+                    sys.executable, os.path.abspath(__file__),
+                    "--worker", role,
+                    "--bus-dir", bus_dir,
+                    "--resp-port", str(resp.resp_port),
+                    "--partition", str(i),
+                    "--result", os.path.join(work, f"{name}_result.json"),
+                ])
+        for name, w in workers.items():
+            w.await_ready()
+            print(f"fleet: {name} ready (ops={w.ports['ops']}, "
+                  f"grpc={w.ports['grpc']})")
+
+        members = {
+            name: f"http://127.0.0.1:{w.ports['ops']}"
+            for name, w in workers.items()
+        }
+        FLEET.install(members, interval_s=0.25, timeout_s=2.0)
+        FLEET.start()
+
+        def drive_all(slices: list, out: dict) -> None:
+            threads = [
+                threading.Thread(
+                    target=drive_partition,
+                    args=(
+                        f"127.0.0.1:{workers[f'gw{i}'].ports['grpc']}",
+                        slices[i], out[f"gw{i}"],
+                    ),
+                )
+                for i in range(N_PARTITIONS)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        # -- warm-up slice, drained before the measured window ----------
+        # The first device dispatches compile (seconds on CPU); an
+        # open-loop drive during compile measures XLA warm-up backlog,
+        # not the fleet. The warm-up slice triggers those compiles and
+        # the table below covers only the steady remainder.
+        warm_n = [min(48, len(p) // 3) for p in parts]
+        warm: dict[str, dict] = {f"gw{i}": {} for i in range(N_PARTITIONS)}
+        drive_all([parts[i][:warm_n[i]] for i in range(N_PARTITIONS)], warm)
+        warm_drained = [
+            await_drained(members[f"c{i}"], warm_n[i], timeout_s=120.0)
+            for i in range(N_PARTITIONS)
+        ]
+        print(f"fleet: warm-up {warm_n} drained={warm_drained}")
+
+        # -- measured drive of both partitions concurrently over gRPC ---
+        drive: dict[str, dict] = {f"gw{i}": {} for i in range(N_PARTITIONS)}
+        t0 = time.perf_counter()
+        drive_all([parts[i][warm_n[i]:] for i in range(N_PARTITIONS)], drive)
+        drive_wall = time.perf_counter() - t0
+        n_measured = n_orders - sum(warm_n)
+        print(f"fleet: drive done in {drive_wall:.2f}s "
+              f"({n_measured / drive_wall:.0f} orders/s aggregate)")
+
+        # -- drain, then hold a steady observation window ---------------
+        drained = [
+            await_drained(
+                members[f"c{i}"], len(parts[i]), timeout_s=60.0
+            )
+            for i in range(N_PARTITIONS)
+        ]
+        print(f"fleet: drained={drained}")
+        window_s = max(2.0, min(10.0, args.seconds * 0.1))
+        time.sleep(window_s)
+
+        # -- stitch journeys BEFORE stopping members --------------------
+        exports = FLEET.journeys()
+        stitch = stitch_journeys(exports)
+        per_part_stitched = []
+        for i in range(N_PARTITIONS):
+            pair = {f"gw{i}", f"c{i}"}
+            per_part_stitched.append(sum(
+                1 for j in stitch["journeys"] if pair <= set(j["procs"])
+            ))
+        print(f"fleet: stitched {stitch['joined']}/{stitch['traces']} "
+              f"traces (per partition {per_part_stitched}, "
+              f"offsets {stitch['offsets']})")
+
+        # -- merged metrics + losslessness proof ------------------------
+        member_exps = {}
+        for name, url in members.items():
+            member_exps[name] = FLEET._fetch(url + "/metrics", 2.0)
+        merged = merge_expositions(member_exps)
+        merged_text = render_exposition(merged)
+        reparsed = parse_exposition(merged_text)
+        merge_roundtrip_ok = render_exposition(reparsed) == merged_text
+        consumed_fam = merged.get("gome_orders_consumed_total")
+        member_consumed = 0.0
+        for text in member_exps.values():
+            fam = parse_exposition(text).get("gome_orders_consumed_total")
+            if fam is not None:
+                member_consumed += family_total(fam)
+        merged_consumed = family_total(consumed_fam) if consumed_fam else -1.0
+        accepted = sum(
+            d.get("codes", {}).get("0", 0)
+            for phase in (warm, drive) for d in phase.values()
+        )
+
+        payload = FLEET.payload()
+        rollup = FLEET.rollup()
+        FLEET.stop()
+    finally:
+        results: dict[str, dict] = {}
+        for name, w in workers.items():
+            rc = w.stop()
+            path = os.path.join(work, f"{name}_result.json")
+            try:
+                with open(path) as f:
+                    results[name] = json.load(f)
+            except (OSError, ValueError):
+                results[name] = {}
+            results[name]["exit_code"] = rc
+        if resp is not None:
+            resp.kill()
+            resp.wait(timeout=10)
+        from gome_tpu.obs.fleet import FLEET as _F
+
+        _F.disable()
+
+    # -- queue-level fleet audit (durable record, post-shutdown) --------
+    audits = []
+    for i in range(N_PARTITIONS):
+        n_events, seqs = read_match_seqs(bus_dirs[i])
+        audits.append({
+            "partition": i,
+            "events": n_events,
+            "stamped": len(seqs),
+            "seq_audit": audit_seqs(seqs),
+        })
+
+    # -- throughput table (measured window only: warm-up excluded) ------
+    lat_by_part = {}
+    for i in range(N_PARTITIONS):
+        js = sorted(
+            (j for j in stitch["journeys"] if f"c{i}" in j["procs"]),
+            key=lambda j: j["start"],
+        )
+        # The flight-recorder ring evicts oldest-first, so the tail of
+        # the sorted list IS the measured window; take at most the
+        # measured count from the end.
+        keep = min(len(js), len(parts[i]) - warm_n[i])
+        lat_by_part[i] = [j["duration_s"] for j in js[len(js) - keep:]]
+    lat_all = [d for i in range(N_PARTITIONS) for d in lat_by_part[i]]
+    procs_table = {}
+    for i in range(N_PARTITIONS):
+        gw, con = f"gw{i}", f"c{i}"
+        measured_sent = drive[gw].get("sent", 0)
+        consumed = results.get(con, {}).get("orders_consumed", 0)
+        procs_table[gw] = {
+            "role": "gateway", "partition": i,
+            "orders_sent": measured_sent + warm[gw].get("sent", 0),
+            "orders_measured": measured_sent,
+            "orders_per_sec": round(measured_sent / drive_wall, 1),
+            "grpc_codes": drive[gw].get("codes", {}),
+            "rusage": results.get(gw, {}).get("rusage"),
+        }
+        procs_table[con] = {
+            "role": "consumer", "partition": i,
+            "orders_consumed": consumed,
+            "orders_per_sec": round(measured_sent / drive_wall, 1),
+            "feed": results.get(con, {}).get("feed"),
+            "rusage": results.get(con, {}).get("rusage"),
+        }
+    table = {
+        "drive_wall_s": round(drive_wall, 3),
+        "warmup_orders": warm_n,
+        "procs": procs_table,
+        "fleet": {
+            "orders": n_measured,
+            "orders_per_sec": round(n_measured / drive_wall, 1),
+        },
+        "e2e_latency_ms": {
+            "samples": len(lat_all),
+            "p50": _ms(pctl(lat_all, 50)),
+            "p90": _ms(pctl(lat_all, 90)),
+            "p99": _ms(pctl(lat_all, 99)),
+            "per_partition": {
+                str(i): {
+                    "samples": len(lat_by_part[i]),
+                    "p50": _ms(pctl(lat_by_part[i], 50)),
+                    "p99": _ms(pctl(lat_by_part[i], 99)),
+                }
+                for i in range(N_PARTITIONS)
+            },
+        },
+    }
+
+    feed_states = [
+        results.get(f"c{i}", {}).get("feed") or {}
+        for i in range(N_PARTITIONS)
+    ]
+    checks = {
+        "all_members_ready": len(results) == 2 * N_PARTITIONS,
+        "all_members_exited_clean": all(
+            r.get("exit_code") == 0 for r in results.values()
+        ),
+        "all_members_healthy": (
+            rollup["polls"] >= 4 and rollup["unhealthy_polls"] == 0
+            and rollup["fetch_errors"] == 0
+        ),
+        "zero_degradations": (
+            rollup["degraded_polls"] == 0
+            and accepted == n_orders
+            and not any(
+                "transport_error" in d
+                for phase in (warm, drive) for d in phase.values()
+            )
+        ),
+        "all_partitions_drained": all(drained) and all(warm_drained),
+        "exactly_once_fleet": all(
+            a["seq_audit"]["dupes"] == 0 and a["seq_audit"]["gaps"] == 0
+            for a in audits
+        ) and all(
+            f.get("dupes") == 0 and f.get("gaps") == 0 for f in feed_states
+        ),
+        "stitched_per_partition": all(n >= 1 for n in per_part_stitched),
+        "merge_roundtrip": merge_roundtrip_ok,
+        "merge_lossless": (
+            merged_consumed == member_consumed == float(accepted)
+            and accepted > 0
+        ),
+        "fleet_payload_serves": (
+            payload.get("enabled") is True
+            and "exposition" in (payload.get("metrics") or {})
+        ),
+    }
+    verdict = {
+        "schema": SCHEMA,
+        "config": {
+            "seed": args.seed,
+            "seconds": args.seconds,
+            "n_steps": n_steps,
+            "frames": len(frames),
+            "orders": n_orders,
+            "partitions": N_PARTITIONS,
+            "orders_per_partition": [len(p) for p in parts],
+            "symbols_per_partition": sym_counts,
+            "engine": {
+                "n_slots": N_LANES, "max_t": T_BINS,
+                "cap": 64, "max_fills": 8, "dtype": "int64",
+            },
+        },
+        "table": table,
+        "rollup": rollup,
+        "stitch": {
+            "traces": stitch["traces"],
+            "joined": stitch["joined"],
+            "per_partition": per_part_stitched,
+            "offsets_s": {
+                k: round(v, 6) for k, v in stitch["offsets"].items()
+            },
+        },
+        "merge": {
+            "families": len(merged),
+            "roundtrip_identical": merge_roundtrip_ok,
+            "orders_consumed_total": {
+                "merged": merged_consumed,
+                "sum_of_members": member_consumed,
+                "grpc_accepted": accepted,
+            },
+        },
+        "seq": {"partitions": audits},
+        "members": {
+            name: {
+                "exit_code": r.get("exit_code"),
+                "role": r.get("role"),
+                "partition": r.get("partition"),
+            }
+            for name, r in results.items()
+        },
+        "checks": checks,
+        "pass": all(checks.values()),
+    }
+    write_json(args.out, verdict)
+    status = "PASS" if verdict["pass"] else "FAIL"
+    print(f"fleet: {status} -> {args.out}")
+    print(f"fleet: {n_measured} measured orders over {N_PARTITIONS} "
+          f"partitions in {drive_wall:.2f}s = "
+          f"{n_measured / drive_wall:.0f} orders/s "
+          f"(e2e p50 {table['e2e_latency_ms']['p50']} ms over "
+          f"{len(lat_all)} stitched journeys)")
+    for name, ok in checks.items():
+        print(f"  [{'ok' if ok else 'BREACH'}] {name}")
+    return 0 if verdict["pass"] else 1
+
+
+def _ms(s: float | None) -> float | None:
+    return None if s is None else round(s * 1e3, 3)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seconds", type=int, default=30,
+                    help="drill scale knob: sim steps = seconds*8 (clamped)")
+    ap.add_argument("--seed", type=int, default=13)
+    ap.add_argument("--out", default="FLEET_r01.json",
+                    help="verdict JSON path (parent mode)")
+    ap.add_argument("--workdir", default="",
+                    help="scratch dir (default: fresh tempdir)")
+    # worker mode (internal)
+    ap.add_argument("--worker", choices=("gateway", "consumer"),
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--bus-dir", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--resp-port", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--partition", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--result", default="", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.worker == "gateway":
+        return run_gateway_worker(args)
+    if args.worker == "consumer":
+        return run_consumer_worker(args)
+    return run_parent(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

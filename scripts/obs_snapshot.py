@@ -25,6 +25,11 @@ in-process service stack and dump the operator surfaces to files —
                           plus the live wall-profile join
   <out_dir>/hostprof_collapsed.txt  the collapsed-stack (flamegraph
                           text) dump behind /hostprof?format=collapsed
+  <out_dir>/fleet.json    the /fleet payload: the fleet aggregator's
+                          merged view (per-member health + rollup,
+                          counter-summed / proc-labeled merged
+                          exposition, fleet-wide seq audit) over a
+                          scripted two-member view of this process
 
     python scripts/obs_snapshot.py [out_dir=obs-artifacts]
 
@@ -207,6 +212,54 @@ def main(out_dir: str = "obs-artifacts") -> int:
     with open(os.path.join(out_dir, "metrics.txt"), "w") as f:
         f.write(metrics)
 
+    # The /fleet payload (gome_tpu.obs.fleet): the aggregator federated
+    # over a scripted two-member view of THIS process — fetch is
+    # injected so no socket is bound; every surface is produced by the
+    # same code path the HTTP endpoint serves. Two members sharing one
+    # process exercises the real merge: counter totals double, gauges
+    # fan out under proc labels, and the merged exposition must
+    # re-render as a byte-identical (scrape-valid) document.
+    from gome_tpu.obs.fleet import FLEET
+    from gome_tpu.utils.metrics import parse_exposition, render_exposition
+
+    surfaces = {
+        "/metrics": lambda: REGISTRY.render(),
+        "/healthz": lambda: json.dumps(
+            ops.monitor.check().as_dict(), default=str
+        ),
+        "/durability": lambda: json.dumps(ops.durability_payload()),
+        "/timeline": lambda: json.dumps(
+            ops.timeline_payload(), default=str
+        ),
+        "/trace?format=journeys": lambda: json.dumps(
+            TRACER.recorder.export()
+        ),
+    }
+
+    def in_process_fetch(url: str, timeout_s: float) -> str:
+        for suffix, fn in surfaces.items():
+            if url.endswith(suffix):
+                return fn()
+        raise ValueError(f"unexpected fleet fetch: {url}")
+
+    FLEET.install(
+        {"alpha": "inproc://alpha", "beta": "inproc://beta"},
+        fetch=in_process_fetch,
+    )
+    FLEET.poll()
+    fleet_doc = FLEET.payload()
+    assert fleet_doc["enabled"], "fleet aggregator did not arm"
+    fleet_metrics = fleet_doc["metrics"]
+    assert "error" not in fleet_metrics, fleet_metrics.get("error")
+    merged_text = fleet_metrics["exposition"]
+    assert render_exposition(parse_exposition(merged_text)) == merged_text, (
+        "merged exposition does not re-render scrape-identically"
+    )
+    assert 'proc="alpha"' in merged_text, "gauge union lost the proc label"
+    with open(os.path.join(out_dir, "fleet.json"), "w") as f:
+        json.dump(fleet_doc, f, indent=1, default=str)
+    FLEET.disable()
+
     journeys = {
         ev["args"]["trace_id"]
         for ev in dump["traceEvents"]
@@ -224,7 +277,9 @@ def main(out_dir: str = "obs-artifacts") -> int:
         + (f", perfetto at {perfetto_out}" if perfetto_out else "")
         + f"), {out_dir}/hostprof.json "
         f"({drill['sampler']['samples']} host samples, "
-        f"{drill['admit_ns_per_order']} ns/order admit)"
+        f"{drill['admit_ns_per_order']} ns/order admit), "
+        f"{out_dir}/fleet.json ({len(fleet_doc['members'])} members, "
+        f"{len(fleet_metrics['families'])} merged families)"
     )
     JOURNAL.disable()
     TIMELINE.disable()

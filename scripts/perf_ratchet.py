@@ -231,6 +231,32 @@ def recovery_advisory() -> dict:
         return {"recovery.advisory_error": f"{type(exc).__name__}: {exc}"}
 
 
+def fleet_advisory() -> dict:
+    """Fleet-aggregate surface (round 10), ADVISORY only — wall-clock.
+
+    Sourced from the committed fleet verdict (FLEET_r01.json at the repo
+    root, regenerated by scripts/fleet_drill.py): aggregate orders/sec
+    over the measured (post-warm-up) drive window, the stitched
+    cross-process end-to-end latency p50, member count, and the verdict
+    outcome. Never gateable — a shared CI runner's wall-clock is not a
+    regression signal — but printed loudly every run so the fleet
+    numbers ride along with the analytic ratchet."""
+    try:
+        path = os.path.join(ROOT, "FLEET_r01.json")
+        with open(path) as f:
+            verdict = json.load(f)
+        table = verdict["table"]
+        return {
+            "fleet.orders_per_sec": table["fleet"]["orders_per_sec"],
+            "fleet.stitched_p50_ms": table["e2e_latency_ms"]["p50"],
+            "fleet.members": len(verdict["members"]),
+            "fleet.partitions": verdict["config"]["partitions"],
+            "fleet.verdict_pass": bool(verdict["pass"]),
+        }
+    except Exception as exc:  # pragma: no cover - env-specific
+        return {"fleet.advisory_error": f"{type(exc).__name__}: {exc}"}
+
+
 def collect() -> dict:
     """{"jax": version, "gated": {...}, "advisory": {...}}."""
     import jax
@@ -244,6 +270,7 @@ def collect() -> dict:
     advisory.update(skew_advisory())
     advisory.update(gateway_advisory())
     advisory.update(recovery_advisory())
+    advisory.update(fleet_advisory())
     return {
         "jax": jax.__version__,
         "gated": gated,
@@ -396,6 +423,22 @@ def main(argv: list[str] | None = None) -> int:
             "# WARNING (advisory, non-gating): the committed chaos "
             "verdict has pass=false — tests/test_chaos.py should be "
             "failing; investigate before trusting recovery numbers"
+        )
+    fleet_rate = current["advisory"].get("fleet.orders_per_sec")
+    if fleet_rate is not None:
+        print(
+            f"# ADVISORY (never gated, wall-clock): fleet aggregate "
+            f"{fleet_rate} orders/sec over "
+            f"{current['advisory'].get('fleet.partitions')} partitions, "
+            f"stitched cross-process e2e p50 "
+            f"{current['advisory'].get('fleet.stitched_p50_ms')} ms "
+            "(FLEET_r01.json; regenerate with scripts/fleet_drill.py)"
+        )
+    if current["advisory"].get("fleet.verdict_pass") is False:
+        print(
+            "# WARNING (advisory, non-gating): the committed fleet "
+            "verdict has pass=false — tests/test_fleet.py should be "
+            "failing; investigate before trusting fleet numbers"
         )
     if regressions:
         print(f"perf_ratchet: {len(regressions)} regressed metric(s):")

@@ -1,0 +1,426 @@
+"""Fleet observability (round 10): the Prometheus-exposition parse/merge
+engine (utils.metrics), cross-process trace stitching + the FleetAggregator
+singleton (obs.fleet), the /fleet ops endpoint, FileQueue cross-process
+tailing, per-queue depth gauges, and the committed fleet verdict
+(FLEET_r01.json, produced by scripts/fleet_drill.py)."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from gome_tpu.bus.filelog import FileQueue
+from gome_tpu.config import Config, EngineConfig, FleetConfig, OpsConfig
+from gome_tpu.obs.fleet import (
+    FLEET,
+    FleetAggregator,
+    estimate_offsets,
+    stitch_journeys,
+    stitched_chrome_trace,
+)
+from gome_tpu.utils.metrics import (
+    Registry,
+    family_total,
+    merge_expositions,
+    parse_exposition,
+    render_exposition,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- exposition parse / merge ------------------------------------------------
+
+
+def _member_registry(orders: int, rss: float, queue_depth: int) -> Registry:
+    """One member's metric surface: a counter, a labeled gauge, a plain
+    gauge, and a labeled histogram — every shape Registry.render()
+    emits."""
+    reg = Registry()
+    c = reg.counter("gome_orders_consumed_total", "orders drained")
+    for _ in range(orders):
+        c.inc()
+    reg.gauge("gome_rss_bytes", "resident set size").set(rss)
+    reg.gauge(
+        "gome_bus_depth", "queue depth", labels={"queue": "doOrder"}
+    ).set(queue_depth)
+    h = reg.histogram(
+        "gome_stage_seconds", "per-stage latency",
+        buckets=(0.001, 0.01, 0.1), labels={"stage": "ingress"},
+    )
+    for v in (0.0005, 0.005, 0.05, 0.5):
+        h.observe(v)
+    return reg
+
+
+def test_parse_render_roundtrip_is_byte_identical():
+    """parse -> re-render must reproduce Registry.render() output
+    byte-for-byte: the merged fleet exposition is a real scrape
+    target, not a lossy summary."""
+    text = _member_registry(7, 12345678.0, 3).render()
+    fams = parse_exposition(text)
+    assert render_exposition(fams) == text
+    # And a second round trip is a fixed point.
+    assert render_exposition(parse_exposition(render_exposition(fams))) == (
+        text
+    )
+
+
+def test_merge_is_lossless_and_labels_procs():
+    a = _member_registry(10, 100.0, 2).render()
+    b = _member_registry(32, 200.0, 5).render()
+    merged = merge_expositions({"gw0": a, "c0": b})
+
+    # Counters sum per label set.
+    assert family_total(merged["gome_orders_consumed_total"]) == 42
+    assert (
+        family_total(parse_exposition(a)["gome_orders_consumed_total"])
+        + family_total(parse_exposition(b)["gome_orders_consumed_total"])
+        == 42
+    )
+    # Histograms merge bucket-wise: counts sum, bucket edges survive.
+    stage = merged["gome_stage_seconds"]
+    count_samples = [
+        s for s in stage.samples if s.name == "gome_stage_seconds_count"
+    ]
+    assert [s.value for s in count_samples] == [8.0]
+    les = [
+        s.labels["le"] for s in stage.samples
+        if s.name == "gome_stage_seconds_bucket"
+    ]
+    assert les == ["0.001", "0.01", "0.1", "+Inf"]
+    # Gauges union under a new proc label — both members' values survive.
+    rss = merged["gome_rss_bytes"]
+    assert {s.labels["proc"]: s.value for s in rss.samples} == {
+        "gw0": 100.0, "c0": 200.0,
+    }
+    depth = merged["gome_bus_depth"]
+    assert {
+        (s.labels["proc"], s.labels["queue"]): s.value
+        for s in depth.samples
+    } == {("gw0", "doOrder"): 2.0, ("c0", "doOrder"): 5.0}
+    # The merged document re-renders as a valid, stable exposition.
+    text = render_exposition(merged)
+    assert render_exposition(parse_exposition(text)) == text
+
+
+def test_merge_rejects_bucket_mismatch_and_type_conflict():
+    reg_a = Registry()
+    reg_a.histogram("h", "x", buckets=(1.0, 2.0)).observe(1.5)
+    reg_b = Registry()
+    reg_b.histogram("h", "x", buckets=(1.0, 4.0)).observe(1.5)
+    with pytest.raises(ValueError, match="bucket"):
+        merge_expositions({"a": reg_a.render(), "b": reg_b.render()})
+
+    reg_c = Registry()
+    reg_c.counter("m", "x").inc()
+    reg_d = Registry()
+    reg_d.gauge("m", "x").set(1.0)
+    with pytest.raises(ValueError, match="conflicting types"):
+        merge_expositions({"a": reg_c.render(), "b": reg_d.render()})
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_exposition("not a metric line at all {{{\n")
+
+
+# -- trace stitching ---------------------------------------------------------
+
+
+def _two_process_fixture(offset: float = 100.0, transit: float = 0.002):
+    """A scripted gateway + consumer export pair whose clocks differ by
+    a KNOWN offset: the consumer's perf_counter reads `offset` seconds
+    ahead of the gateway's. bus_transit t0 is sender-clock (carried in
+    the wire context); everything else in the consumer export is
+    consumer-clock."""
+    gw = {"pid": 101, "journeys": [
+        {"trace_id": "t1", "open": True,
+         "spans": [["ingress", 1.000, 1.001, None],
+                   ["enqueue", 1.001, 1.002, None]]},
+        {"trace_id": "t2", "open": True,
+         "spans": [["ingress", 2.000, 2.001, None],
+                   ["enqueue", 2.001, 2.002, None]]},
+    ]}
+    con = {"pid": 202, "journeys": [
+        {"trace_id": "t1", "open": False,
+         "spans": [["bus_transit", 1.002, 1.002 + transit + offset, None],
+                   ["device_execute",
+                    1.010 + offset, 1.015 + offset, None]]},
+        {"trace_id": "t2", "open": False,
+         "spans": [["bus_transit",
+                    2.002, 2.002 + transit + offset + 0.001, None],
+                   ["device_execute",
+                    2.010 + offset, 2.014 + offset, None]]},
+    ]}
+    return {"gw": gw, "con": con}
+
+
+def test_estimate_offsets_uses_min_transit():
+    exports = _two_process_fixture(offset=100.0, transit=0.002)
+    offsets = estimate_offsets(exports)
+    # min over t1 - t0 of bus_transit: the t1 clock is off by +100 s,
+    # so the estimate is offset + fastest transit.
+    assert offsets == {("gw", "con"): pytest.approx(100.002)}
+
+
+def test_stitch_aligns_receiver_spans_onto_sender_clock():
+    exports = _two_process_fixture(offset=100.0, transit=0.002)
+    stitch = stitch_journeys(exports)
+    assert stitch["traces"] == 2 and stitch["joined"] == 2
+    assert stitch["offsets"] == {"gw->con": pytest.approx(100.002)}
+    j1 = next(j for j in stitch["journeys"] if j["trace_id"] == "t1")
+    assert j1["sender"] == "gw"
+    assert j1["procs"] == ["con", "gw"]
+    by_stage = {s["stage"]: s for s in j1["spans"]}
+    # bus_transit: t0 already sender-clock, only t1 shifted.
+    assert by_stage["bus_transit"]["t0"] == pytest.approx(1.002)
+    assert by_stage["bus_transit"]["t1"] == pytest.approx(1.002)
+    # device_execute shifted fully onto the sender clock.
+    assert by_stage["device_execute"]["t0"] == pytest.approx(1.008)
+    # Spans are time-ordered and the journey spans the whole pipeline.
+    t0s = [s["t0"] for s in j1["spans"]]
+    assert t0s == sorted(t0s)
+    assert j1["start"] == pytest.approx(1.000)
+    # end = device_execute t1 shifted by the offset ESTIMATE (true offset
+    # + fastest transit), so 1.015 - 0.002 relative to the sender clock.
+    assert j1["duration_s"] == pytest.approx(0.013, abs=1e-6)
+
+
+def test_stitched_chrome_trace_tracks_per_process():
+    stitch = stitch_journeys(_two_process_fixture())
+    doc = stitched_chrome_trace(stitch)
+    names = [
+        ev["args"]["name"] for ev in doc["traceEvents"]
+        if ev.get("ph") == "M"
+    ]
+    assert sorted(names) == ["con", "gw"]
+    xs = [ev for ev in doc["traceEvents"] if ev.get("ph") == "X"]
+    assert xs and all(ev["ts"] >= 0 for ev in xs)
+    assert len({ev["pid"] for ev in xs}) == 2
+
+
+def test_stitch_skips_single_process_traces():
+    exports = _two_process_fixture()
+    del exports["con"]["journeys"][0]  # t1 now gateway-only
+    stitch = stitch_journeys(exports)
+    assert stitch["traces"] == 2 and stitch["joined"] == 1
+    assert stitch["journeys"][0]["trace_id"] == "t2"
+
+
+# -- the aggregator singleton ------------------------------------------------
+
+
+def test_disabled_poll_is_zero_alloc():
+    """The unarmed aggregator is one attribute check, zero allocations —
+    the same sys.getallocatedblocks guard as the tracer/journal/
+    timeline/faults singletons."""
+    agg = FleetAggregator()  # never installed
+    assert not agg.enabled
+
+    def drill(n):
+        i = 0
+        while i < n:
+            if agg.poll() is not None:
+                raise AssertionError("unreachable")
+            i += 1
+
+    drill(64)  # warm lazy caches
+    before = sys.getallocatedblocks()
+    drill(200)
+    after = sys.getallocatedblocks()
+    assert after - before <= 2, f"disabled poll() allocated {after - before}"
+
+
+def test_aggregator_polls_scripted_members():
+    surfaces = {
+        "a": _member_registry(3, 1.0, 0),
+        "b": _member_registry(4, 2.0, 1),
+    }
+
+    def fetch(url, timeout_s):
+        proc, _, path = url.partition("://")[2].partition("/")
+        path = "/" + path
+        if path == "/metrics":
+            return surfaces[proc].render()
+        if path == "/healthz":
+            return json.dumps({"healthy": True, "detail": {}})
+        if path == "/durability":
+            return json.dumps({"matchfeed": {
+                "last_seq": 6, "observed": 7, "dupes": 0, "gaps": 0,
+            }})
+        if path.startswith("/timeline"):
+            return json.dumps({"samples": []})
+        raise AssertionError(url)
+
+    reg = Registry()
+    agg = FleetAggregator()
+    agg.install(
+        {"a": "inproc://a", "b": "inproc://b"}, registry=reg, fetch=fetch
+    )
+    try:
+        snap = agg.poll()
+        assert snap["a"]["healthy"] and not snap["a"]["degraded"]
+        payload = agg.payload()
+        assert payload["enabled"]
+        assert set(payload["members"]) == {"a", "b"}
+        assert payload["seq"]["fleet"]["observed"] == 14
+        fams = payload["metrics"]["families"]
+        assert fams["gome_orders_consumed_total"]["total"] == 7
+        text = payload["metrics"]["exposition"]
+        assert render_exposition(parse_exposition(text)) == text
+        roll = agg.rollup()
+        assert roll["polls"] >= 1 and roll["unhealthy_polls"] == 0
+        assert reg.render().count("gome_fleet_members 2") == 1
+    finally:
+        agg.disable()
+    assert agg.poll() is None
+
+
+def test_fleet_http_endpoint_serves_merged_view():
+    """/fleet over real HTTP on a live service: the singleton aggregator
+    federates the service's own ops endpoint and the payload's merged
+    exposition is scrape-valid."""
+    svc = None
+    try:
+        from gome_tpu.service.app import EngineService
+
+        svc = EngineService(Config(
+            engine=EngineConfig(cap=32, n_slots=16, max_t=8, dtype="int32"),
+            ops=OpsConfig(
+                enabled=True, port=0, profile=False, hostprof=False,
+            ),
+        ))
+        svc.ops.start()
+        base = f"http://127.0.0.1:{svc.ops.port}"
+        FLEET.install({"self": base}, interval_s=5.0)
+        FLEET.poll()
+        with urllib.request.urlopen(base + "/fleet", timeout=5) as resp:
+            assert resp.status == 200
+            doc = json.loads(resp.read().decode())
+        assert doc["enabled"] and set(doc["members"]) == {"self"}
+        text = doc["metrics"]["exposition"]
+        assert render_exposition(parse_exposition(text)) == text
+        assert "gome_bus_depth" in text
+    finally:
+        FLEET.disable()
+        if svc is not None:
+            svc.stop()
+
+
+def test_fleet_config_member_map():
+    fc = FleetConfig(
+        enabled=True, members=("gw0=http://h:1", {"c0": "http://h:2"})
+    )
+    assert fc.member_map() == {"gw0": "http://h:1", "c0": "http://h:2"}
+    with pytest.raises(ValueError, match="duplicate"):
+        FleetConfig(
+            enabled=True, members=("x=http://h:1", "x=http://h:2")
+        ).member_map()
+    with pytest.raises(ValueError):
+        FleetConfig(enabled=True, members=())
+    with pytest.raises(ValueError):
+        FleetConfig(members=("nourl",))
+
+
+# -- cross-process file-queue tailing ---------------------------------------
+
+
+def test_filelog_reader_tails_external_appends(tmp_path):
+    """A reader FileQueue instance sees records appended through a
+    DIFFERENT instance (the fleet's live gateway-writer / consumer-
+    reader split over one log)."""
+    base = str(tmp_path / "doOrder")
+    reader = FileQueue("doOrder", base)
+    writer = FileQueue("doOrder", base)
+    assert reader.end_offset() == 0
+    writer.publish(b"one")
+    writer.publish(b"two")
+    assert reader.end_offset() == 2
+    msgs = reader.read_from(0, 10)
+    assert [m.body for m in msgs] == [b"one", b"two"]
+    assert [m.offset for m in msgs] == [0, 1]
+    writer.publish(b"three")
+    assert [m.body for m in reader.read_from(2, 10)] == [b"three"]
+    writer.close()
+    reader.close()
+
+
+def test_filelog_tail_skips_incomplete_record_without_truncating(tmp_path):
+    """A torn tail mid-append by the live writer is SKIPPED by the
+    tailing reader (never truncated — the writer finishes it); the
+    record becomes visible once complete."""
+    import struct
+
+    base = str(tmp_path / "q")
+    writer = FileQueue("q", base)
+    writer.publish(b"whole")
+    reader = FileQueue("q", base)
+    assert reader.end_offset() == 1
+    # Simulate the writer mid-append: length prefix + partial payload.
+    record = struct.pack(">I", 6) + b"par"
+    with open(base + ".log", "ab") as f:
+        f.write(record)
+    assert reader.end_offset() == 1  # incomplete tail not indexed
+    size_before = os.path.getsize(base + ".log")
+    with open(base + ".log", "ab") as f:
+        f.write(b"tia")  # writer completes the record
+    assert os.path.getsize(base + ".log") == size_before + 3
+    assert reader.end_offset() == 2
+    assert reader.read_from(1, 1)[0].body == b"partia"
+    writer.close()
+    reader.close()
+
+
+def test_queue_depth_gauges_export(tmp_path):
+    from gome_tpu.bus.base import export_queue_metrics
+    from gome_tpu.bus.memory import MemoryQueue
+
+    reg = Registry()
+    q = MemoryQueue("doOrder")
+    export_queue_metrics(q, registry=reg)
+    q.publish(b"a")
+    q.publish(b"b")
+    q.commit(1)
+    text = reg.render()
+    assert 'gome_bus_depth{queue="doOrder"} 1' in text
+    assert 'gome_bus_end_offset{queue="doOrder"} 2' in text
+    assert 'gome_bus_committed_offset{queue="doOrder"} 1' in text
+
+
+# -- the committed verdict ---------------------------------------------------
+
+
+def test_fleet_verdict_pin():
+    """FLEET_r01.json (committed, regenerated by scripts/fleet_drill.py)
+    stays green and keeps its schema: the aggregate table, the stitch
+    section, the lossless-merge proof, and every check passing."""
+    path = os.path.join(ROOT, "FLEET_r01.json")
+    with open(path) as f:
+        verdict = json.load(f)
+    assert verdict["schema"] == "gome-fleet-verdict-v1"
+    assert verdict["pass"] is True
+    assert all(verdict["checks"].values()), verdict["checks"]
+    assert set(verdict["checks"]) >= {
+        "all_members_healthy", "zero_degradations", "exactly_once_fleet",
+        "stitched_per_partition", "merge_roundtrip", "merge_lossless",
+    }
+    table = verdict["table"]
+    assert table["fleet"]["orders_per_sec"] > 0
+    assert table["e2e_latency_ms"]["p50"] > 0
+    assert len(
+        [p for p in table["procs"].values() if p["role"] == "gateway"]
+    ) == verdict["config"]["partitions"]
+    assert all(n >= 1 for n in verdict["stitch"]["per_partition"])
+    merge = verdict["merge"]
+    assert merge["roundtrip_identical"] is True
+    assert (
+        merge["orders_consumed_total"]["merged"]
+        == merge["orders_consumed_total"]["sum_of_members"]
+        == merge["orders_consumed_total"]["grpc_accepted"]
+    )
+    for part in verdict["seq"]["partitions"]:
+        assert part["seq_audit"]["dupes"] == 0
+        assert part["seq_audit"]["gaps"] == 0
