@@ -222,6 +222,46 @@ def _host_block():
         return None
 
 
+def _admit_block():
+    """Scalar-vs-columnar admit comparison (gome_tpu.obs.hostprof.
+    bench_admit) folded into the mixed-stream SERVICE payload next to
+    the host block: the IDENTICAL seeded flow through the single-order
+    DoOrder path and the round-11 columnar DoOrderBatch core, side by
+    side with the speedup ratio — so BENCH_SERVICE_*.json records the
+    front-door rework's headline win. BENCH_ADMIT=0 skips; failures
+    degrade to a stderr note, never a broken bench."""
+    if os.environ.get("BENCH_ADMIT", "1") == "0":
+        return None
+    try:
+        from gome_tpu.obs import hostprof
+
+        return hostprof.bench_admit()
+    except Exception as e:
+        print(f"# admit bench unavailable: {e}", file=sys.stderr)
+        return None
+
+
+def admit_main():
+    """--admit: the scalar-vs-columnar admit comparison standalone —
+    host-only (no jax import, no engine), prints the bench_admit JSON
+    payload. The fastest way to see the round-11 front-door numbers on
+    any machine."""
+    from gome_tpu.obs import hostprof
+
+    doc = hostprof.bench_admit()
+    print(json.dumps(doc, indent=1))
+    s, c = doc["scalar"], doc["columnar"]
+    print(
+        f"# admit: scalar {s['admit_ns_per_order']} ns/order "
+        f"({s['admit_orders_per_sec_per_core'] / 1e3:.0f}K/sec/core) vs "
+        f"columnar {c['admit_ns_per_order']} ns/order "
+        f"({c['admit_orders_per_sec_per_core'] / 1e3:.0f}K/sec/core) — "
+        f"{doc['speedup_x']}x",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def _jit_cache_sizes(**fns):
     """{name: compiled-variant count} for the bench's own jits — the
     payload's compile count (how many distinct shapes the timed chain
@@ -1036,6 +1076,9 @@ def service_main():
     host = _host_block()
     if host is not None:
         result["host"] = host
+    admit = _admit_block()
+    if admit is not None:
+        result["admit"] = admit
     print(json.dumps(result))
     print(
         f"# mixed vs clean: on-link {mixed['throughput'] / 1e3:.0f}K vs "
@@ -1847,6 +1890,8 @@ def main():
         return _shard_consumer_main()
     if "--gateway-proc" in sys.argv:
         return _gateway_proc_main()
+    if "--admit" in sys.argv:
+        return admit_main()
     if "--latency" in sys.argv:
         return latency_main()
     if "--grpc-scale" in sys.argv:

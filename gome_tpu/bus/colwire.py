@@ -44,6 +44,16 @@ ORDER_MAGIC_V1 = b"GCO1"  # decode-compat: pre-cache dict-column layout
 #: order carries a context, so tracing-off traffic stays byte-identical
 #: GCO2 — zero wire overhead on the hot path.
 ORDER_MAGIC_TRACED = b"GCO3"
+#: The columnar-front-door layout (round 11): a HEADER (u32 total order
+#: count + u32 block count) followed by back-to-back GCO2-style BODIES
+#: ("blocks"), each with its own count/dictionaries. The gateway's
+#: columnar admit encodes one block per gRPC batch on the handler thread;
+#: the batcher's flush is then a pure byte-join — no decode/re-encode
+#: round-trip, no per-order Python anywhere between proto and frame.
+#: Single-block frames decode through the exact GCO2 body reader (same
+#: dict-cache identity semantics); multi-block frames merge on the
+#: consumer side, which has ~13x the gateway's CPU headroom (HOSTPROF).
+ORDER_MAGIC_BLOCKS = b"GCO4"
 EVENT_MAGIC = b"GCE1"
 #: GCE1 + one u64 base sequence number after the count: event i in the
 #: frame is matchfeed seq ``seq0 + i`` (exactly-once across restarts —
@@ -198,6 +208,36 @@ def _read_padded_column(buf: memoryview, off: int, n: int):
     return arr, off
 
 
+def encode_order_block(
+    n: int,
+    action: np.ndarray,
+    side: np.ndarray,
+    kind: np.ndarray,
+    price: np.ndarray,
+    volume: np.ndarray,
+    symbols: list[str],
+    symbol_idx: np.ndarray,
+    uuids: list[str],
+    uuid_idx: np.ndarray,
+    oids,
+) -> bytes:  # gomelint: hotpath
+    """One ORDER block BODY (no magic): u32 count + numeric columns +
+    dict-encoded symbols/uuids + padded oids — exactly a GCO2 body, so a
+    single block prefixed with ORDER_MAGIC is a valid GCO2 frame and
+    GCO4 is a pure framing of these. This is what the columnar gateway
+    encodes per gRPC batch (array inputs straight from the admit masks,
+    never per-order Python)."""
+    parts = [struct.pack("<I", n)]
+    for (_name, dt), col in zip(
+        _ORDER_NUM, (action, side, kind, price, volume)
+    ):
+        parts.append(np.ascontiguousarray(col, dt).tobytes())
+    parts.append(_pack_dict_column(symbols, symbol_idx))
+    parts.append(_pack_dict_column(uuids, uuid_idx))
+    parts.append(_pack_padded_column(oids))
+    return b"".join(parts)
+
+
 def encode_order_frame(
     n: int,
     action: np.ndarray,
@@ -217,17 +257,30 @@ def encode_order_frame(
     traces: optional per-order trace-context strings ('' = untraced) —
     selects the GCO3 layout (a trailing padded column)."""
     magic = ORDER_MAGIC if traces is None else ORDER_MAGIC_TRACED
-    parts = [magic, struct.pack("<I", n)]
-    for (_name, dt), col in zip(
-        _ORDER_NUM, (action, side, kind, price, volume)
-    ):
-        parts.append(np.ascontiguousarray(col, dt).tobytes())
-    parts.append(_pack_dict_column(symbols, symbol_idx))
-    parts.append(_pack_dict_column(uuids, uuid_idx))
-    parts.append(_pack_padded_column(oids))
-    if traces is not None:
-        parts.append(_pack_padded_column(traces))
-    return b"".join(parts)
+    body = encode_order_block(
+        n, action, side, kind, price, volume, symbols, symbol_idx,
+        uuids, uuid_idx, oids,
+    )
+    if traces is None:
+        return magic + body
+    return b"".join((magic, body, _pack_padded_column(traces)))
+
+
+def encode_order_frame_blocks(blocks: list[bytes]) -> bytes:  # gomelint: hotpath
+    """Pre-encoded ORDER blocks -> one GCO4 frame: magic + u32 total
+    order count + u32 block count + the blocks back to back. The total
+    is read off each block's leading u32 — the flush path stays a byte
+    join, never a decode."""
+    if not blocks:
+        raise ValueError("GCO4 frame needs at least one block")
+    n_total = 0
+    for b in blocks:
+        (n,) = struct.unpack_from("<I", b, 0)
+        n_total += n
+    return b"".join(
+        [ORDER_MAGIC_BLOCKS, struct.pack("<II", n_total, len(blocks))]
+        + list(blocks)
+    )
 
 
 def encode_orders(orders) -> bytes:
@@ -270,19 +323,13 @@ def encode_orders(orders) -> bytes:
     )
 
 
-def decode_order_frame(payload: bytes) -> dict:
-    """ORDER frame -> dict of numpy columns + string dictionaries:
-    {action,side,kind,price,volume: np arrays; symbols: list[str],
-    symbol_idx: u32 array; uuids, uuid_idx; oids: np 'S' array}."""
-    buf = memoryview(payload)
-    magic = bytes(buf[:4])
-    if magic not in (ORDER_MAGIC, ORDER_MAGIC_V1, ORDER_MAGIC_TRACED):
-        raise ValueError("not an ORDER frame")
-    read_dict = (
-        _read_dict_column_v1 if magic == ORDER_MAGIC_V1 else _read_dict_column
-    )
-    (n,) = struct.unpack_from("<I", buf, 4)
-    off = 8
+def _read_order_body(buf: memoryview, off: int, read_dict):
+    """One ORDER body (u32 count + columns) -> (cols dict, new offset) —
+    shared by the GCO1/GCO2/GCO3 frame decoders and the per-block GCO4
+    loop, so every layout funnels through identical column parsing (and
+    the same dict-column identity cache)."""
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
     out: dict = {"n": n}
     for name, dt in _ORDER_NUM:
         out[name] = np.frombuffer(buf, dt, n, off)
@@ -290,10 +337,109 @@ def decode_order_frame(payload: bytes) -> dict:
     out["symbols"], out["symbol_idx"], off = read_dict(buf, off, n)
     out["uuids"], out["uuid_idx"], off = read_dict(buf, off, n)
     out["oids"], off = _read_padded_column(buf, off, n)
+    return out, off
+
+
+# Merged multi-block dictionaries, keyed on the identity of the per-block
+# uniques lists (which the _dict_cache keeps stable for a stable symbol
+# universe), so a steady flow of same-shaped GCO4 frames reuses one merged
+# list object — downstream identity caches (the engine's symbol->lane map,
+# the native pre-pool's packed tables) keep hitting. Values pin the part
+# lists so an id() can never be recycled while its key is live; the
+# whole-tuple identity is re-verified on hit anyway (IdentityCache's
+# discipline). Same GIL-atomicity + LRU reasoning as _dict_cache above.
+_merge_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+_MERGE_CACHE_MAX = 32
+
+
+def _merge_dicts(parts: list) -> tuple:
+    """Per-block uniques lists -> (merged uniques list, per-block u32
+    remap arrays): remap[i] is the merged id of part value i, so a
+    block's index column remaps in one vectorized gather."""
+    key = tuple(map(id, parts))
+    hit = _merge_cache.get(key)
+    if hit is not None and all(
+        a is b for a, b in zip(hit[0], parts)
+    ):
+        try:
+            _merge_cache.move_to_end(key)
+        except KeyError:  # concurrently evicted; value is still valid
+            pass
+        return hit[1], hit[2]
+    ix: dict = {}
+    merged: list = []
+    remaps = []
+    for vals in parts:
+        remap = np.empty(len(vals), np.uint32)
+        for j, s in enumerate(vals):
+            k = ix.get(s)
+            if k is None:
+                k = ix[s] = len(merged)
+                merged.append(s)
+            remap[j] = k
+        remaps.append(remap)
+    while len(_merge_cache) >= _MERGE_CACHE_MAX:
+        try:
+            _merge_cache.popitem(last=False)
+        except KeyError:  # concurrent evictor got there first
+            break
+    _merge_cache[key] = (list(parts), merged, remaps)
+    return merged, remaps
+
+
+def _merge_order_blocks(blocks: list) -> dict:
+    """Decoded GCO4 blocks -> one standard cols dict: numeric columns
+    concatenate, dictionary columns merge through _merge_dicts (stable
+    merged-list identity), oids concatenate with 'S' width promotion."""
+    out: dict = {"n": int(sum(b["n"] for b in blocks))}
+    for name, _dt in _ORDER_NUM:
+        out[name] = np.concatenate([b[name] for b in blocks])
+    for values_key, idx_key in (
+        ("symbols", "symbol_idx"), ("uuids", "uuid_idx")
+    ):
+        merged, remaps = _merge_dicts([b[values_key] for b in blocks])
+        out[values_key] = merged
+        out[idx_key] = np.concatenate(
+            [remap[b[idx_key]] for remap, b in zip(remaps, blocks)]
+        )
+    out["oids"] = np.concatenate([b["oids"] for b in blocks])
+    return out
+
+
+def decode_order_frame(payload: bytes) -> dict:
+    """ORDER frame -> dict of numpy columns + string dictionaries:
+    {action,side,kind,price,volume: np arrays; symbols: list[str],
+    symbol_idx: u32 array; uuids, uuid_idx; oids: np 'S' array}. All
+    layouts (GCO1-GCO4) normalize to this one contract, so the consumer
+    and engine frame path never see the wire version."""
+    buf = memoryview(payload)
+    magic = bytes(buf[:4])
+    if magic == ORDER_MAGIC_BLOCKS:
+        n_total, n_blocks = struct.unpack_from("<II", buf, 4)
+        off = 12
+        blocks = []
+        for _ in range(n_blocks):
+            block, off = _read_order_body(buf, off, _read_dict_column)
+            blocks.append(block)
+        if n_blocks == 1:
+            out = blocks[0]  # the GCO2-identical fast path
+        else:
+            out = _merge_order_blocks(blocks)
+        if out["n"] != n_total:
+            raise ValueError(
+                f"GCO4 header count {n_total} != block sum {out['n']}"
+            )
+        return out
+    if magic not in (ORDER_MAGIC, ORDER_MAGIC_V1, ORDER_MAGIC_TRACED):
+        raise ValueError("not an ORDER frame")
+    read_dict = (
+        _read_dict_column_v1 if magic == ORDER_MAGIC_V1 else _read_dict_column
+    )
+    out, off = _read_order_body(buf, 4, read_dict)
     if magic == ORDER_MAGIC_TRACED:
         # Per-order trace contexts ride the frame; engine code never reads
         # this key (the consumer peels it off before processing).
-        out["trace"], off = _read_padded_column(buf, off, n)
+        out["trace"], off = _read_padded_column(buf, off, out["n"])
     return out
 
 

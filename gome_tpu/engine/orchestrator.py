@@ -76,6 +76,16 @@ class MatchEngine:
         mark does not linger forever (and leak into snapshots)."""
         self.pre_pool.discard(self._prekey(order))
 
+    def mark_frame(self, cols: dict) -> None:  # gomelint: hotpath
+        """Bulk mark for the columnar admit path: one fused pass over an
+        ORDER block's columns (ADD rows only — the pool implementations
+        share that contract with mark())."""
+        self.pre_pool.mark_frame(cols)
+
+    def unmark_frame(self, cols: dict) -> None:
+        """Bulk undo of mark_frame — the columnar emit-failure path."""
+        self.pre_pool.unmark_frame(cols)
+
     # -- consumer side -----------------------------------------------------
     def process(self, orders: list[Order]) -> list[MatchResult]:
         """Apply one micro-batch in arrival order; returns the MatchResult

@@ -49,6 +49,39 @@ class LocalPrePool(set):
                 out.append(False)
         return out
 
+    def _frame_keys(self, cols: dict):
+        """Key tuples of the frame's ADD rows (the numpy fallback of the
+        native marker's fused pass: one vectorized row select, then
+        C-speed zip/update — no per-order Python function calls)."""
+        act = np.ascontiguousarray(cols["action"])
+        sel = np.nonzero(act == int(Action.ADD))[0]
+        if not len(sel):
+            return None
+        syms, uuids = cols["symbols"], cols["uuids"]
+        sidx = np.asarray(cols["symbol_idx"])[sel].tolist()
+        uidx = np.asarray(cols["uuid_idx"])[sel].tolist()
+        oids = np.asarray(cols["oids"])[sel].tolist()
+        return zip(
+            map(syms.__getitem__, sidx),
+            map(uuids.__getitem__, uidx),
+            (o.decode() for o in oids),
+        )
+
+    def mark_frame(self, cols: dict) -> None:  # gomelint: hotpath
+        """Gateway-side bulk marking of a built ORDER block's ADDs
+        (main.go:42-45 for a whole frame) — the columnar admit path's
+        numpy fallback when native host ops are unavailable."""
+        keys = self._frame_keys(cols)
+        if keys is not None:
+            self.update(keys)
+
+    def unmark_frame(self, cols: dict) -> None:
+        """Undo mark_frame (emit failed after marking: the frame never
+        entered the pipeline, so no marker may dangle)."""
+        keys = self._frame_keys(cols)
+        if keys is not None:
+            self.difference_update(keys)
+
 
 def consume_batch_of(pool, keys: list[Key]) -> list[bool]:
     """consume_batch for any pool object — uses the pool's own batched
@@ -167,6 +200,27 @@ class RespPrePool:
                     [("HSET", k, *fv) for k, fv in by_key.items()]
                 )
             )
+
+    def unmark_frame(self, cols: dict) -> None:
+        """Undo mark_frame for the frame's ADD rows (columnar emit failed
+        after marking): one pipelined round trip of HDELs — the bulk
+        mirror of the gateway's per-order unmark."""
+        syms, uuids = cols["symbols"], cols["uuids"]
+        sidx = cols["symbol_idx"].tolist()
+        uidx = cols["uuid_idx"].tolist()
+        oids = cols["oids"].tolist()
+        ADD = int(Action.ADD)
+        cmds = []
+        for a, k, u, o in zip(cols["action"].tolist(), sidx, uidx, oids):
+            if a != ADD:
+                continue
+            sym = syms[k]
+            cmds.append((
+                "HDEL", f"{sym}:comparison",
+                f"{sym}:{uuids[u]}:{o.decode()}",
+            ))
+        if cmds:
+            self._check(self.client.pipeline(cmds))
 
     @staticmethod
     def _check(replies: list) -> list:
@@ -372,6 +426,23 @@ class NativePrePool:
     def mark_frame(self, cols: dict) -> None:
         """Gateway-side bulk marking (main.go:42-45 for a whole frame)."""
         self._frame(cols, mode=1)
+
+    def unmark_frame(self, cols: dict) -> None:
+        """Undo mark_frame for the frame's ADD rows. Emit-failure path
+        (rare by construction), so a per-row gp_discard loop is fine —
+        no fused C mode needed."""
+        act = np.ascontiguousarray(cols["action"])
+        sel = np.nonzero(act == int(Action.ADD))[0]
+        if not len(sel):
+            return
+        syms, uuids = cols["symbols"], cols["uuids"]
+        sidx = np.asarray(cols["symbol_idx"])[sel].tolist()
+        uidx = np.asarray(cols["uuid_idx"])[sel].tolist()
+        oids = np.asarray(cols["oids"])[sel].tolist()
+        lib, h = self._lib, self._h
+        for s, u, o in zip(sidx, uidx, oids):
+            b = self._ckey((syms[s], uuids[u], o.decode()))
+            lib.gp_discard(h, b, len(b))
 
 
 def make_prepool():

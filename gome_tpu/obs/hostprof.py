@@ -139,6 +139,19 @@ STAGE_RULES = (
     ("service.gateway", "_validate_add", "validate"),
     ("service.gateway", "order_from_request", "order_build"),
     ("gome_tpu.fixed", "scale", "order_build"),
+    # Columnar admit core (round 11): the array-native equivalents of the
+    # scalar stages above, mapped onto the SAME stage names so r01/r02
+    # profiles stay comparable column for column.
+    ("service.gateway", "_vector_scale", "validate"),
+    ("service.gateway", "_recheck_rows", "validate"),
+    ("service.gateway", "_intern", "order_build"),
+    ("service.gateway", "orders_from_columns", "order_build"),
+    ("service.gateway", "_mark_cols", "mark"),
+    ("service.gateway", "_unmark_cols", "mark"),
+    ("service.gateway", "_emit_cols", "enqueue"),
+    ("service.batcher", "submit_block", "enqueue"),
+    ("bus.colwire", "encode_order_block", "codec_encode"),
+    ("bus.colwire", "encode_order_frame_blocks", "codec_encode"),
     ("engine.orchestrator", "mark", "mark"),
     ("engine.orchestrator", "unmark", "mark"),
     ("engine.orchestrator", "_prekey", "mark"),
@@ -163,7 +176,10 @@ STAGE_RULES = (
     ("service.gateway", "DoOrderBatch", "ingress"),
     ("service.gateway", "DoOrderStream", "ingress"),
     ("service.gateway", "_apply_entries", "ingress"),
+    ("service.gateway", "_apply_columnar", "ingress"),
     ("service.gateway", "_begin_trace", "ingress"),
+    ("engine.orchestrator", "mark_frame", "mark"),
+    ("engine.orchestrator", "unmark_frame", "mark"),
 )
 
 
@@ -521,6 +537,25 @@ def _drill_requests(n: int, seed: int, n_symbols: int = 64,
     return reqs
 
 
+def _drill_batches(reqs: list, batch_n: int) -> list:
+    """Pre-built OrderBatchRequest protos (cancel masks preserved) from
+    _drill_requests pairs — the columnar drill's unit of work. Pre-built
+    for the same reason the scalar requests are: the sampled loop
+    measures ADMIT, not proto construction."""
+    from ..api import order_pb2 as pb
+
+    batches = []
+    for i in range(0, len(reqs), batch_n):
+        chunk = reqs[i : i + batch_n]
+        batches.append(
+            pb.OrderBatchRequest(
+                orders=[r for r, _ in chunk],
+                cancel=[c for _, c in chunk],
+            )
+        )
+    return batches
+
+
 def _drill_mark(pool, order) -> None:
     """The drill's pre-pool mark: the reference's S:U:O key into a
     LocalPrePool — same work shape as MatchEngine.mark/_prekey without
@@ -528,15 +563,36 @@ def _drill_mark(pool, order) -> None:
     pool.add((order.symbol, order.uuid, order.oid))
 
 
-def _drill_gateway():
+def _drill_gateway(columnar: bool = False):
     """A fresh OrderGateway on a fresh in-process bus (per round, so the
-    memory queue's log never grows unbounded across rounds)."""
+    memory queue's log never grows unbounded across rounds). Returns
+    (gateway, batcher) — batcher is None on the scalar path; the
+    columnar variant gets the bulk pre-pool markers and a FrameBatcher
+    whose deadline can never fire mid-round (the drill flushes inside
+    its own timing window, then close()s the round's batcher outside
+    it)."""
     from ..bus import MemoryQueue, QueueBus
     from ..engine.prepool import LocalPrePool
     from ..service.gateway import OrderGateway
 
     pool = LocalPrePool()
     bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    batcher = None
+    if columnar:
+        from ..service.batcher import FrameBatcher
+
+        try:
+            # The columnar path's production marker: the fused C pass
+            # (native/hostops.cc, ~8.7M marks/sec). LocalPrePool's numpy
+            # row-select is the fallback where the library isn't built.
+            from ..engine.prepool import NativePrePool
+
+            pool = NativePrePool()
+        except (RuntimeError, OSError):
+            pass
+        batcher = FrameBatcher(
+            bus.order_queue, max_n=2048, max_wait_s=60.0
+        )
     gateway = OrderGateway(
         bus,
         accuracy=8,
@@ -544,8 +600,12 @@ def _drill_gateway():
         unmark=lambda order: pool.discard(
             (order.symbol, order.uuid, order.oid)
         ),
+        mark_frame=pool.mark_frame,
+        unmark_frame=pool.unmark_frame,
+        batcher=batcher,
+        columnar=columnar,
     )
-    return gateway
+    return gateway, batcher
 
 
 def gateway_drill(
@@ -555,18 +615,34 @@ def gateway_drill(
     min_samples: int = 350,
     max_rounds: int = 6,
     mode: str = "auto",
+    path: str = "scalar",
+    batch_n: int = 1024,
 ) -> dict:
     """Measure the gateway admit path: drive pre-built requests through
-    ``DoOrder``/``DeleteOrder`` on an in-process bus under the sampler.
-    Repeats the n_orders round (fresh gateway each round) until the
-    sampler holds ``min_samples`` stacks or ``max_rounds`` is hit, so
-    the stage split is statistically meaningful while the admit
-    ns/order itself is a plain wall/N measurement."""
+    ``DoOrder``/``DeleteOrder`` (path="scalar") or the SAME seeded flow
+    as OrderBatchRequests through the columnar ``DoOrderBatch`` core +
+    FrameBatcher (path="columnar"), on an in-process bus under the
+    sampler. Repeats the n_orders round (fresh gateway each round) until
+    the sampler holds ``min_samples`` stacks or ``max_rounds`` is hit,
+    so the stage split is statistically meaningful while the admit
+    ns/order itself is a plain wall/N measurement. Columnar rounds are
+    ~100x shorter, so callers wanting a tight stage split pass a higher
+    max_rounds; the final in-window flush() charges the frame join to
+    the admit cost it belongs to."""
+    if path not in ("scalar", "columnar"):
+        raise ValueError(f"unknown drill path {path!r}")
+    columnar = path == "columnar"
     reqs = _drill_requests(n_orders, seed)
+    batches = _drill_batches(reqs, batch_n) if columnar else None
     # Warm pb internals, codec, and the admit path outside the window.
-    warm = _drill_gateway()
-    for req, is_del in reqs[:256]:
-        (warm.DeleteOrder if is_del else warm.DoOrder)(req, None)
+    warm, warm_b = _drill_gateway(columnar=columnar)
+    if columnar:
+        for breq in batches[: max(1, 4096 // batch_n)]:
+            warm.DoOrderBatch(breq, None)
+        warm_b.close()
+    else:
+        for req, is_del in reqs[:256]:
+            (warm.DeleteOrder if is_del else warm.DoOrder)(req, None)
 
     sampler = HostSampler(
         hz=hz, keep=DEFAULT_KEEP, mode=mode, all_threads=False
@@ -579,16 +655,25 @@ def gateway_drill(
         while rounds < max_rounds and (
             done == 0 or sampler.samples < min_samples
         ):
-            gateway = _drill_gateway()
-            do_order = gateway.DoOrder
-            do_delete = gateway.DeleteOrder
-            t0 = time.perf_counter_ns()
-            for req, is_del in reqs:
-                if is_del:
-                    do_delete(req, None)
-                else:
-                    do_order(req, None)
-            wall_ns += time.perf_counter_ns() - t0
+            gateway, batcher = _drill_gateway(columnar=columnar)
+            if columnar:
+                do_batch = gateway.DoOrderBatch
+                t0 = time.perf_counter_ns()
+                for breq in batches:
+                    do_batch(breq, None)
+                batcher.flush()
+                wall_ns += time.perf_counter_ns() - t0
+                batcher.close()  # outside the window: thread teardown
+            else:
+                do_order = gateway.DoOrder
+                do_delete = gateway.DeleteOrder
+                t0 = time.perf_counter_ns()
+                for req, is_del in reqs:
+                    if is_del:
+                        do_delete(req, None)
+                    else:
+                        do_order(req, None)
+                wall_ns += time.perf_counter_ns() - t0
             done += len(reqs)
             rounds += 1
     finally:
@@ -596,8 +681,9 @@ def gateway_drill(
 
     ns_per_order = wall_ns / max(done, 1)
     join = stage_join(sampler.counts(), n_orders=done, window_ns=wall_ns)
-    return {
+    out = {
         "kind": "gateway_admit_drill",
+        "path": path,
         "seed": seed,
         "orders": done,
         "rounds": rounds,
@@ -622,8 +708,20 @@ def gateway_drill(
             "OrderGateway (LocalPrePool mark, JSON codec, in-process "
             "MemoryQueue publish); ns/order is wall/N, per-stage rows "
             "distribute that wall by sampled share"
+        )
+        if not columnar
+        else (
+            "host-only columnar admit loop: pre-built OrderBatchRequests "
+            "-> OrderGateway._apply_columnar (numpy masks, bulk "
+            "LocalPrePool mark_frame, GCO4 block encode, FrameBatcher "
+            "submit_block, in-process MemoryQueue publish); the final "
+            "flush is inside the timing window; ns/order is wall/N, "
+            "per-stage rows distribute that wall by sampled share"
         ),
     }
+    if columnar:
+        out["batch_n"] = batch_n
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -698,10 +796,16 @@ def hostprof_artifact(
     seed: int = 7,
     min_samples: int = 800,
     max_rounds: int = 8,
+    artifact: str = "HOSTPROF_r01",
+    path: str = "scalar",
+    batch_n: int = 1024,
 ) -> dict:
-    """The HOSTPROF_r01.json payload: the gateway admit drill (per-stage
+    """The HOSTPROF_rNN.json payload: the gateway admit drill (per-stage
     ns/order, >= 80% coverage by construction of the stage map) plus the
-    host-vs-device roofline table."""
+    host-vs-device roofline table. Defaults reproduce HOSTPROF_r01 (the
+    scalar before-baseline); artifact="HOSTPROF_r02", path="columnar"
+    (with a much higher max_rounds — columnar rounds are ~100x shorter)
+    produces the columnar after-measurement the perf ratchet gates."""
     import platform
 
     drill = gateway_drill(
@@ -710,9 +814,11 @@ def hostprof_artifact(
         seed=seed,
         min_samples=min_samples,
         max_rounds=max_rounds,
+        path=path,
+        batch_n=batch_n,
     )
     return {
-        "artifact": "HOSTPROF_r01",
+        "artifact": artifact,
         "method": (
             "in-process sampling profiler (obs.hostprof.HostSampler, "
             f"{drill['sampler']['mode']} mode @ {hz} Hz) over a "
@@ -748,6 +854,50 @@ def bench_host(
             for st, row in drill["stages"].items()
         },
     }
+
+
+def bench_admit(
+    n_orders: int = 16_384,
+    seed: int = 7,
+    min_samples: int = 64,
+    batch_n: int = 1024,
+) -> dict:
+    """The compact ``"admit"`` block bench.py folds into the mixed-stream
+    service payload (and serves under ``--admit``): scalar vs columnar
+    admit on the IDENTICAL seeded flow, side by side with the speedup
+    ratio — the front-door rework's headline comparison, cheap enough
+    for CI."""
+    scalar = gateway_drill(
+        n_orders=n_orders, seed=seed, min_samples=min_samples,
+        max_rounds=2, path="scalar",
+    )
+    columnar = gateway_drill(
+        n_orders=n_orders, seed=seed, min_samples=min_samples,
+        max_rounds=24, path="columnar", batch_n=batch_n,
+    )
+
+    def _row(d: dict) -> dict:
+        return {
+            "admit_ns_per_order": d["admit_ns_per_order"],
+            "admit_orders_per_sec_per_core": (
+                d["admit_orders_per_sec_per_core"]
+            ),
+            "orders": d["orders"],
+            "rounds": d["rounds"],
+            "coverage_pct": d["coverage_pct"],
+        }
+
+    out = {
+        "kind": "admit_bench",
+        "seed": seed,
+        "batch_n": batch_n,
+        "scalar": _row(scalar),
+        "columnar": _row(columnar),
+    }
+    s, c = scalar["admit_ns_per_order"], columnar["admit_ns_per_order"]
+    if s and c:
+        out["speedup_x"] = round(s / c, 2)
+    return out
 
 
 # ---------------------------------------------------------------------------

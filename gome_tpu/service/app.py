@@ -128,6 +128,8 @@ class EngineService:
             accuracy=e.accuracy,
             mark=self.engine.mark,
             unmark=self.engine.unmark,
+            mark_frame=self.engine.mark_frame,
+            unmark_frame=self.engine.unmark_frame,
             match_feed=self.feed,
             max_volume=LOT_MAX32 if e.dtype == "int32" else None,
         )

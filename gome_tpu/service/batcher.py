@@ -39,7 +39,7 @@ import threading
 import time
 from collections import deque
 
-from ..bus.colwire import encode_orders
+from ..bus.colwire import encode_order_frame_blocks, encode_orders
 from ..types import Order
 from ..utils.logging import get_logger
 from ..utils.metrics import REGISTRY
@@ -88,7 +88,15 @@ class FrameBatcher:
         self.max_wait_s = max_wait_s
         self.spill_max_frames = spill_max_frames
         self.retry_interval_s = retry_interval_s
-        self._buf: list[Order] = []  # guarded by self._lock
+        # Mixed buffer: scalar handlers append Order objects, the columnar
+        # admit core appends pre-encoded wire BLOCKS (bytes) via
+        # submit_block — flushing walks contiguous runs so arrival order
+        # is preserved across both producers without re-decoding blocks.
+        self._buf: list[Order | bytes] = []  # guarded by self._lock
+        # _buf_n is the buffered ORDER count (a bytes block counts its
+        # n orders, an Order counts 1), kept incrementally because
+        # len(_buf) undercounts once blocks land.
+        self._buf_n = 0  # guarded by self._lock
         self._spill: deque[bytes] = deque()  # guarded by self._lock
         self._degraded_since: float | None = None  # guarded by self._lock
         self.degraded_seconds_total = 0.0  # guarded by self._lock
@@ -111,7 +119,7 @@ class FrameBatcher:
             "gome_gateway_buffered_orders",
             "orders buffered in the batcher awaiting a frame flush "
             "(the batching-bridge queue depth)",
-            lambda: len(self._buf),  # gomelint: disable=GL402 — see above
+            lambda: self._buf_n,  # gomelint: disable=GL402 — see above
         )
         REGISTRY.callback_gauge(
             "gome_gateway_degraded_seconds",
@@ -148,7 +156,7 @@ class FrameBatcher:
                 + degraded_s,
                 spill_depth=len(self._spill),
                 spill_max_frames=self.spill_max_frames,
-                buffered=len(self._buf),
+                buffered=self._buf_n,
             )
 
     def submit(self, order: Order) -> None:  # gomelint: hotpath
@@ -182,7 +190,33 @@ class FrameBatcher:
                 self._oldest = time.monotonic()
                 self._wake.set()
             self._buf.append(order)
-            if len(self._buf) >= self.max_n:
+            self._buf_n += 1
+            if self._buf_n >= self.max_n:
+                self._flush_locked()
+
+    def submit_block(self, block: bytes, n: int) -> None:  # gomelint: hotpath
+        """Buffer one pre-encoded ORDER wire block of `n` accepted orders
+        (the columnar admit core's output, bus.colwire.encode_order_block);
+        flush if the size bound tripped. Same closed/backpressure contract
+        as submit() — a refused block means NONE of its orders were
+        accepted (the gateway unmarks and rejects the whole batch)."""
+        with self._lock:
+            if self._stop:
+                raise RuntimeError(
+                    "FrameBatcher is closed; order not accepted"
+                )
+            if len(self._spill) >= self.spill_max_frames:
+                _rejects.inc(n)
+                raise Backpressure(
+                    f"bus degraded: spill full "
+                    f"({self.spill_max_frames} frames); retry later"
+                )
+            if not self._buf:
+                self._oldest = time.monotonic()
+                self._wake.set()
+            self._buf.append(block)
+            self._buf_n += n
+            if self._buf_n >= self.max_n:
                 self._flush_locked()
 
     def flush(self) -> int:
@@ -191,14 +225,42 @@ class FrameBatcher:
         with self._lock:
             return self._flush_locked()
 
-    def _flush_locked(self) -> int:
-        batch = self._swap_locked()
+    def _encode_order_run(self, orders: list[Order]) -> bytes:
+        if TRACER.enabled:
+            orders = self._close_batch_wait(orders)
+        return encode_orders(orders)
+
+    def _flush_locked(self) -> int:  # gomelint: hotpath
+        batch, n = self._swap_locked()
         if batch:
-            if TRACER.enabled:
-                batch = self._close_batch_wait(batch)
-            self._spill.append(encode_orders(batch))
+            # Split into contiguous runs so arrival order survives mixed
+            # producers: an Order run becomes one GCO2/GCO3 frame (pure
+            # scalar traffic stays byte-identical to the pre-columnar
+            # wire), a block run becomes ONE GCO4 frame with no
+            # decode/re-encode round-trip — the columnar path's whole
+            # point (HOSTPROF_r01: the JSON round-trip was ~45% of admit
+            # CPU).
+            orders: list[Order] = []
+            blocks: list[bytes] = []
+            for item in batch:
+                if isinstance(item, bytes):
+                    if orders:
+                        self._spill.append(self._encode_order_run(orders))
+                        orders = []
+                    blocks.append(item)
+                else:
+                    if blocks:
+                        self._spill.append(
+                            encode_order_frame_blocks(blocks)
+                        )
+                        blocks = []
+                    orders.append(item)
+            if orders:
+                self._spill.append(self._encode_order_run(orders))
+            if blocks:
+                self._spill.append(encode_order_frame_blocks(blocks))
         self._drain_spill_locked()
-        return len(batch)
+        return n
 
     @staticmethod
     def _close_batch_wait(batch: list[Order]) -> list[Order]:
@@ -247,10 +309,11 @@ class FrameBatcher:
             self._degraded_since = None
             log.info("bus recovered: degraded mode over, spill drained")
 
-    def _swap_locked(self) -> list[Order]:
+    def _swap_locked(self):
         batch, self._buf = self._buf, []
+        n, self._buf_n = self._buf_n, 0
         self._oldest = None
-        return batch
+        return batch, n
 
     def _deadline_loop(self) -> None:  # gomelint: hotpath
         while True:

@@ -22,7 +22,13 @@ in-process service stack and dump the operator surfaces to files —
   <out_dir>/hostprof.json the /hostprof payload: the host-CPU sampling
                           profiler's admit-drill report (per-stage
                           gateway ns/order, achievable orders/sec/core)
-                          plus the live wall-profile join
+                          plus the live wall-profile join, PLUS the
+                          columnar admit drill (round 11) under
+                          "columnar_drill" — asserted at capture time
+                          to >= 90% stage coverage with the taxonomy
+                          summing back to the measured window
+  <out_dir>/HOSTPROF_r02.json  copy of the committed columnar admit
+                          roofline so the CI artifact bundle carries it
   <out_dir>/hostprof_collapsed.txt  the collapsed-stack (flamegraph
                           text) dump behind /hostprof?format=collapsed
   <out_dir>/fleet.json    the /fleet payload: the fleet aggregator's
@@ -195,8 +201,50 @@ def main(out_dir: str = "obs-artifacts") -> int:
         f"hostprof drill captured no samples: {hostprof_doc}"
     )
     assert drill["stages"], "hostprof drill attributed no stages"
+    # The COLUMNAR admit drill (round 11, the HOSTPROF_r02 flow at a
+    # CI-sized order count) rides in the same artifact, with the
+    # acceptance assertions enforced at capture time: the stage
+    # taxonomy must attribute >= 90% of the sampled window, and the
+    # per-stage ns/order must sum back to (>= 90% of) the measured
+    # admit ns/order — a taxonomy hole or a stage-join bug fails the
+    # snapshot step loudly instead of shipping a misleading artifact.
+    from gome_tpu.obs import hostprof as hostprof_mod
+
+    # The columnar dispatch rule sends traced RPCs down the scalar path
+    # (per-order trace ids need per-order admits), and this process
+    # armed the tracer at boot — park it for the drill so the measured
+    # flow is the real array-native core, then restore it.
+    _recorder = TRACER.recorder
+    TRACER.disable()
+    try:
+        cdrill = hostprof_mod.gateway_drill(
+            n_orders=16_384, seed=11, min_samples=32, max_rounds=8,
+            path="columnar", batch_n=1024,
+        )
+    finally:
+        TRACER.recorder = _recorder
+    assert cdrill["coverage_pct"] >= 90.0, (
+        f"columnar drill stage coverage {cdrill['coverage_pct']}% < 90%"
+    )
+    stage_sum = sum(
+        row["ns_per_order"] for row in cdrill["stages"].values()
+    )
+    assert stage_sum >= 0.9 * cdrill["admit_ns_per_order"], (
+        f"stage taxonomy sums to {stage_sum:.1f} ns/order, window is "
+        f"{cdrill['admit_ns_per_order']} ns/order"
+    )
+    hostprof_doc["columnar_drill"] = cdrill
     with open(os.path.join(out_dir, "hostprof.json"), "w") as f:
         json.dump(hostprof_doc, f, indent=1, default=str)
+    # The committed roofline artifact rides along in the CI upload so
+    # every push's artifact bundle carries the current HOSTPROF_r02
+    # verdict next to the freshly-measured drill above.
+    r02 = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "HOSTPROF_r02.json")
+    if os.path.exists(r02):
+        import shutil as _shutil
+
+        _shutil.copyfile(r02, os.path.join(out_dir, "HOSTPROF_r02.json"))
     from gome_tpu.obs.hostprof import HOSTPROF
 
     collapsed = HOSTPROF.collapsed()
@@ -277,7 +325,9 @@ def main(out_dir: str = "obs-artifacts") -> int:
         + (f", perfetto at {perfetto_out}" if perfetto_out else "")
         + f"), {out_dir}/hostprof.json "
         f"({drill['sampler']['samples']} host samples, "
-        f"{drill['admit_ns_per_order']} ns/order admit), "
+        f"{drill['admit_ns_per_order']} ns/order scalar admit, "
+        f"{cdrill['admit_ns_per_order']} ns/order columnar admit at "
+        f"{cdrill['coverage_pct']}% coverage), "
         f"{out_dir}/fleet.json ({len(fleet_doc['members'])} members, "
         f"{len(fleet_metrics['families'])} merged families)"
     )

@@ -19,6 +19,8 @@ Two drills:
     python scripts/profile_consumer.py --deterministic     # cProfile drain
     python scripts/profile_consumer.py --gateway           # admit drill
     python scripts/profile_consumer.py --gateway --out HOSTPROF_r01.json
+    python scripts/profile_consumer.py --gateway --columnar \
+        --out HOSTPROF_r02.json                            # columnar admit
 """
 
 import argparse
@@ -38,13 +40,21 @@ PIPE = int(os.environ.get("SVC_PIPELINE", 2))
 
 def gateway_main(args) -> int:
     """The admit-loop drill: host-only (no jax import), deterministic
-    request stream, SIGPROF sampling. Emits the HOSTPROF_r01 payload."""
+    request stream, SIGPROF sampling. Emits the HOSTPROF_r01 payload
+    (scalar path) or, with --columnar, the HOSTPROF_r02 payload (the
+    same seeded flow through the array-native batch admit core)."""
     from gome_tpu.obs import hostprof
 
     doc = hostprof.hostprof_artifact(
         n_orders=args.orders or 30_000,
         seed=args.seed,
         min_samples=args.min_samples,
+        # Columnar rounds are ~100x shorter, so the sample budget needs
+        # far more of them.
+        max_rounds=48 if args.columnar else 8,
+        artifact="HOSTPROF_r02" if args.columnar else "HOSTPROF_r01",
+        path="columnar" if args.columnar else "scalar",
+        batch_n=args.batch_n,
     )
     drill = doc["drill"]
     print(
@@ -221,6 +231,13 @@ def main(argv=None) -> int:
     ap.add_argument("--gateway", action="store_true",
                     help="profile the gateway admit loop (host-only "
                          "drill) instead of the consumer drain")
+    ap.add_argument("--columnar", action="store_true",
+                    help="--gateway: drive the columnar batch admit "
+                         "core (DoOrderBatch -> GCO4) and emit the "
+                         "HOSTPROF_r02 payload")
+    ap.add_argument("--batch-n", type=int, default=1024,
+                    help="--gateway --columnar: orders per "
+                         "OrderBatchRequest")
     ap.add_argument("--deterministic", action="store_true",
                     help="consumer drill: cProfile instead of sampling")
     ap.add_argument("--out", default="",

@@ -1,0 +1,512 @@
+"""Round 11 columnar front door: wire-format pins, cross-version decode
+compat, scalar/columnar admission parity, and the batcher's mixed buffer.
+
+The columnar admit core (service/gateway._apply_columnar) promises
+per-row semantics IDENTICAL to the scalar loop — same accept/reject
+decisions, same reject codes and byte-for-byte messages, same pre-pool
+contents, same decoded orders on the wire — while never running
+per-order Python on the accept path. These tests hold it to that:
+
+  * golden byte pins: the GCO2/GCO3 encodings of a fixed 64-order
+    fixture are pinned by sha256, so any writer-side layout drift is a
+    loud test failure, not a silent wire break;
+  * cross-version decode: a hand-built GCO1 (pre-cache dict layout) and
+    GCO4 frames (single- and multi-block) decode to exactly the GCO2
+    columns — all four layouts normalize to one contract;
+  * parity: seeded batches mixing good, malformed, suspect-range and
+    cancel rows go through a scalar-pinned gateway (columnar=False) and
+    a columnar one side by side, comparing every response field, the
+    pool, and the decoded wire;
+  * abort parity: closed-batcher and degraded-bus failures produce the
+    same code/message/accepted and leave no dangling marks on either
+    path (block-granular unwind — MIGRATION.md round 11);
+  * FrameBatcher.submit_block: closed/backpressure contracts, mixed
+    Order+block buffers flushing as frames in arrival order.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+import numpy as np
+import pytest
+
+from gome_tpu.api import order_pb2 as pb
+from gome_tpu.bus import MemoryQueue, QueueBus
+from gome_tpu.bus.codec import decode_order
+from gome_tpu.bus.colwire import (
+    ORDER_MAGIC,
+    ORDER_MAGIC_BLOCKS,
+    decode_order_frame,
+    encode_order_frame_blocks,
+    encode_orders,
+)
+from gome_tpu.engine.prepool import LocalPrePool
+from gome_tpu.service.batcher import Backpressure, FrameBatcher
+from gome_tpu.service.gateway import OrderGateway, orders_from_columns
+from gome_tpu.types import Action, Order, OrderType, Side
+
+# ---------------------------------------------------------------------------
+# The 64-order golden fixture: every enum value, dict-column reuse
+# (5 uuids / 7 symbols cycling), mixed ADD/DEL and LIMIT/MARKET, and —
+# in the traced variant — a sparse trace column (every 6th order).
+
+
+def mk(i: int, traced: bool = False) -> Order:
+    return Order(
+        uuid=f"u{i % 5}",
+        oid=f"o-{i}",
+        symbol=f"sym{i % 7}",
+        side=Side.BUY if i % 2 else Side.SALE,
+        price=100_0000 + i * 13,
+        volume=1 + (i % 9),
+        action=Action.DEL if i % 8 == 7 else Action.ADD,
+        order_type=OrderType.MARKET if i % 5 == 4 else OrderType.LIMIT,
+        trace=(f"t{i}@{i}.5" if i % 6 == 0 else None) if traced else None,
+    )
+
+
+FIXTURE = [mk(i) for i in range(64)]
+FIXTURE_TRACED = [mk(i, traced=True) for i in range(64)]
+
+# Writer-side layout pins. If encode changes these on purpose, that is a
+# WIRE VERSION BUMP (new magic), not a re-pin: deployed consumers sniff
+# the magic and decode by it, so same-magic bytes must never move.
+GCO2_SHA = "5b3772efcee1dbf2ca8e68ba2714a289fe3979147c68a3c0d5b2d130e6dee2b6"
+GCO3_SHA = "94180ec9a3891f2f1ed9851f69f573bf936dba9e2100a5f114ac46193279ac30"
+
+
+def _cols_equal(a: dict, b: dict) -> None:
+    assert a["n"] == b["n"]
+    for key in ("action", "side", "kind", "price", "volume"):
+        np.testing.assert_array_equal(a[key], b[key])
+    for values_key, idx_key in (
+        ("symbols", "symbol_idx"),
+        ("uuids", "uuid_idx"),
+    ):
+        # Dictionaries may be permuted across layouts; compare the
+        # materialized per-row strings, not the dictionary order.
+        av = [a[values_key][j] for j in np.asarray(a[idx_key]).tolist()]
+        bv = [b[values_key][j] for j in np.asarray(b[idx_key]).tolist()]
+        assert av == bv
+    assert (
+        np.asarray(a["oids"]).tolist() == np.asarray(b["oids"]).tolist()
+    )
+
+
+class TestGoldenWire:
+    def test_gco2_bytes_pinned(self):
+        frame = encode_orders(FIXTURE)
+        assert frame[:4] == ORDER_MAGIC
+        assert hashlib.sha256(frame).hexdigest() == GCO2_SHA
+
+    def test_gco3_bytes_pinned(self):
+        frame = encode_orders(FIXTURE_TRACED)
+        assert frame[:4] == b"GCO3"
+        assert hashlib.sha256(frame).hexdigest() == GCO3_SHA
+
+    def test_roundtrip_recovers_fixture(self):
+        cols = decode_order_frame(encode_orders(FIXTURE))
+        assert orders_from_columns(cols) == FIXTURE
+
+    def test_gco4_single_block_is_a_gco2_body(self):
+        """GCO4 is pure framing: one block's bytes ARE a GCO2 body, so
+        the gateway's per-batch block prefixed with ORDER_MAGIC would be
+        a valid GCO2 frame, and the GCO4 frame is magic + header +
+        exactly those bytes."""
+        gco2 = encode_orders(FIXTURE)
+        body = gco2[4:]
+        frame = encode_order_frame_blocks([body])
+        assert frame == ORDER_MAGIC_BLOCKS + struct.pack("<II", 64, 1) + body
+        _cols_equal(decode_order_frame(frame), decode_order_frame(gco2))
+
+    def test_gco1_decode_compat(self):
+        """A hand-built v1 frame (dict columns WITHOUT the region-length
+        prefix GCO2 added for the decode cache) still decodes to the
+        same columns — deployed pre-cache producers keep working."""
+        ref = decode_order_frame(encode_orders(FIXTURE))
+
+        def dict_v1(values, idx):
+            parts = [struct.pack("<I", len(values))]
+            for s in values:
+                b = s.encode()
+                parts.append(struct.pack("<H", len(b)) + b)
+            parts.append(np.ascontiguousarray(idx, np.uint32).tobytes())
+            return b"".join(parts)
+
+        oids = np.asarray(ref["oids"])
+        v1 = b"".join(
+            [
+                b"GCO1",
+                struct.pack("<I", ref["n"]),
+                np.ascontiguousarray(ref["action"], np.uint8).tobytes(),
+                np.ascontiguousarray(ref["side"], np.uint8).tobytes(),
+                np.ascontiguousarray(ref["kind"], np.uint8).tobytes(),
+                np.ascontiguousarray(ref["price"], np.int64).tobytes(),
+                np.ascontiguousarray(ref["volume"], np.int64).tobytes(),
+                dict_v1(ref["symbols"], ref["symbol_idx"]),
+                dict_v1(ref["uuids"], ref["uuid_idx"]),
+                struct.pack("<H", oids.dtype.itemsize) + oids.tobytes(),
+            ]
+        )
+        _cols_equal(decode_order_frame(v1), ref)
+
+    def test_gco4_multi_block_merges_dictionaries(self):
+        """Blocks with overlapping symbol/uuid universes merge into one
+        deduplicated dictionary with remapped index columns; row order
+        is block order."""
+        splits = [FIXTURE[:20], FIXTURE[20:45], FIXTURE[45:]]
+        bodies = [encode_orders(part)[4:] for part in splits]
+        frame = encode_order_frame_blocks(bodies)
+        cols = decode_order_frame(frame)
+        assert orders_from_columns(cols) == FIXTURE
+        assert len(cols["symbols"]) == len(set(cols["symbols"])) == 7
+        assert len(cols["uuids"]) == len(set(cols["uuids"])) == 5
+
+    def test_gco4_header_count_mismatch_raises(self):
+        frame = bytearray(encode_order_frame_blocks([encode_orders(FIXTURE)[4:]]))
+        frame[4:8] = struct.pack("<I", 63)  # lie about the total
+        with pytest.raises(ValueError, match="GCO4 header count"):
+            decode_order_frame(bytes(frame))
+
+    def test_not_an_order_frame_raises(self):
+        with pytest.raises(ValueError, match="not an ORDER frame"):
+            decode_order_frame(b"GCXX" + b"\x00" * 16)
+
+    def test_empty_blocks_raise(self):
+        with pytest.raises(ValueError, match="at least one block"):
+            encode_order_frame_blocks([])
+
+
+# ---------------------------------------------------------------------------
+# Scalar/columnar admission parity.
+
+
+class _FailingQueue:
+    """A bus order queue whose publish always fails (degraded broker)."""
+
+    supports_headers = False
+
+    def publish(self, body, headers=None):
+        raise ConnectionError("broker down for the drill")
+
+
+def _make_gateway(columnar: bool, queue=None, batcher=None, max_volume=None):
+    queue = queue if queue is not None else MemoryQueue("doOrder")
+    bus = QueueBus(queue, MemoryQueue("matchOrder"))
+    pool = LocalPrePool()
+    gw = OrderGateway(
+        bus,
+        accuracy=8,
+        mark=lambda o: pool.add((o.symbol, o.uuid, o.oid)),
+        unmark=lambda o: pool.discard((o.symbol, o.uuid, o.oid)),
+        mark_frame=pool.mark_frame if columnar else None,
+        unmark_frame=pool.unmark_frame if columnar else None,
+        max_volume=max_volume,
+        batcher=batcher,
+        columnar=columnar,
+    )
+    return gw, pool, bus
+
+
+def _emitted_orders(bus) -> list[Order]:
+    """Decode everything the gateway published — per-order JSON from the
+    scalar path, GCO4 frames from the columnar one — into Order lists
+    (trace excluded from Order equality by the dataclass)."""
+    out: list[Order] = []
+    for msg in bus.order_queue.read_from(0, 10_000):
+        if msg.body[:1] == b"G":
+            out.extend(orders_from_columns(decode_order_frame(msg.body)))
+        else:
+            out.append(decode_order(msg.body))
+    return out
+
+
+def _req(uuid, oid, symbol, side, price, vol, kind=0):
+    return pb.OrderRequest(
+        uuid=uuid, oid=oid, symbol=symbol, transaction=side,
+        price=price, volume=vol, kind=kind,
+    )
+
+
+def _seeded_batches(seed: int, n_batches: int, rows: int):
+    """Batches mixing clean rows with every edge the admit masks must
+    catch: bad enums, non-positive volumes, sub-tick prices, zero-price
+    limits (but zero-price markets are FINE), lot-ceiling breaches,
+    suspect >2**51-tick magnitudes that force the scalar recheck, and
+    random cancel rows."""
+    import random
+
+    rng = random.Random(seed)
+    batches = []
+    for b in range(n_batches):
+        reqs, cancel = [], []
+        for r in range(rows):
+            uuid = f"u{rng.randrange(6)}"
+            oid = f"b{b}r{r}"
+            sym = f"s{rng.randrange(4)}"
+            side = rng.randrange(2)
+            price, vol, kind = 1.0 + rng.randrange(100) / 4.0, float(
+                rng.randrange(1, 50)
+            ), 0
+            is_cancel = False
+            roll = rng.random()
+            if roll < 0.06:
+                side = 7  # invalid enum
+            elif roll < 0.12:
+                kind = 9  # invalid enum
+            elif roll < 0.18:
+                vol = float(-rng.randrange(0, 3))  # <= 0
+            elif roll < 0.24:
+                price = 1.000000001  # sub-tick at accuracy 8
+            elif roll < 0.30:
+                price, kind = 0.0, rng.randrange(2)  # limit rejects, market ok
+            elif roll < 0.36:
+                vol = 200_000.0  # over the 1e12-lot ceiling below
+            elif roll < 0.42:
+                price = 50_000_000.0 + rng.randrange(5)  # suspect range
+            elif roll < 0.55:
+                is_cancel = True
+                if rng.random() < 0.5:
+                    vol = 0.0  # cancels may carry zero volume
+            reqs.append(_req(uuid, oid, sym, side, price, vol, kind))
+            cancel.append(is_cancel)
+        batches.append((reqs, cancel))
+    return batches
+
+
+def _assert_resp_equal(rs, rc):
+    assert rs.code == rc.code
+    assert rs.message == rc.message
+    assert rs.accepted == rc.accepted
+    assert list(rs.reject_index) == list(rc.reject_index)
+    assert [(x.code, x.message) for x in rs.rejects] == [
+        (x.code, x.message) for x in rc.rejects
+    ]
+
+
+class TestScalarColumnarParity:
+    def test_batch_parity_on_seeded_mixed_streams(self):
+        gs, pool_s, bus_s = _make_gateway(False, max_volume=10**12)
+        gc, pool_c, bus_c = _make_gateway(True, max_volume=10**12)
+        saw_reject = saw_cancel = 0
+        for reqs, cancel in _seeded_batches(seed=1234, n_batches=6, rows=80):
+            breq = pb.OrderBatchRequest(orders=reqs, cancel=cancel)
+            rs = gs.DoOrderBatch(breq, None)
+            rc = gc.DoOrderBatch(breq, None)
+            _assert_resp_equal(rs, rc)
+            saw_reject += len(rs.reject_index)
+            saw_cancel += sum(cancel)
+        assert saw_reject > 50 and saw_cancel > 50  # the mix actually mixed
+        assert pool_s == pool_c
+        assert _emitted_orders(bus_s) == _emitted_orders(bus_c)
+
+    def test_batch_parity_all_clean_fast_path(self):
+        """m == n skips the keep-mask gather — pin that branch too."""
+        gs, pool_s, bus_s = _make_gateway(False)
+        gc, pool_c, bus_c = _make_gateway(True)
+        reqs = [
+            _req(f"u{i % 3}", f"o{i}", "s", i % 2, 1.25 + i, 2.0)
+            for i in range(32)
+        ]
+        rs = gs.DoOrderBatch(pb.OrderBatchRequest(orders=reqs), None)
+        rc = gc.DoOrderBatch(pb.OrderBatchRequest(orders=reqs), None)
+        _assert_resp_equal(rs, rc)
+        assert rs.accepted == 32
+        assert pool_s == pool_c and len(pool_c) == 32
+        assert _emitted_orders(bus_s) == _emitted_orders(bus_c)
+
+    def test_stream_parity(self):
+        gs, pool_s, bus_s = _make_gateway(False, max_volume=10**12)
+        gc, pool_c, bus_c = _make_gateway(True, max_volume=10**12)
+        reqs = []
+        for batch, _cancel in _seeded_batches(seed=77, n_batches=3, rows=50):
+            reqs.extend(batch)
+        rs = gs.DoOrderStream(iter(reqs), None)
+        rc = gc.DoOrderStream(iter(reqs), None)
+        _assert_resp_equal(rs, rc)
+        assert pool_s == pool_c
+        assert _emitted_orders(bus_s) == _emitted_orders(bus_c)
+
+    def test_cancel_mask_length_reject_parity(self):
+        for columnar in (False, True):
+            gw, pool, _bus = _make_gateway(columnar)
+            resp = gw.DoOrderBatch(
+                pb.OrderBatchRequest(
+                    orders=[_req("u", "o", "s", 0, 1.0, 1.0)],
+                    cancel=[False, True],
+                ),
+                None,
+            )
+            assert resp.code == 3 and resp.accepted == 0
+            assert "cancel mask length 2 != orders length 1" in resp.message
+            assert not pool
+
+    def test_closed_batcher_abort_parity(self):
+        """Both paths: a leading per-row reject keeps its row status, the
+        abort anchors at the first ACCEPTED entry, and no mark dangles
+        (the columnar block unwinds wholesale)."""
+        responses, pools = [], []
+        for columnar in (False, True):
+            batcher = FrameBatcher(
+                MemoryQueue("doOrder"), max_n=64, max_wait_s=60
+            )
+            batcher.close()
+            gw, pool, _bus = _make_gateway(columnar, batcher=batcher)
+            resp = gw.DoOrderBatch(
+                pb.OrderBatchRequest(
+                    orders=[
+                        _req("u1", "bad", "s", 7, 1.0, 1.0),  # enum reject
+                        _req("u1", "a", "s", 0, 1.0, 1.0),
+                        _req("u2", "b", "s", 1, 1.0, 2.0),
+                    ]
+                ),
+                None,
+            )
+            responses.append(resp)
+            pools.append(pool)
+        rs, rc = responses
+        _assert_resp_equal(rs, rc)
+        assert rc.code == 3 and rc.accepted == 0
+        assert (
+            "batch aborted at entry 1: FrameBatcher is closed" in rc.message
+        )
+        assert list(rc.reject_index) == [0]
+        assert pools[0] == pools[1] == set()
+
+    def test_degraded_bus_abort_parity(self):
+        responses, pools = [], []
+        for columnar in (False, True):
+            gw, pool, _bus = _make_gateway(columnar, queue=_FailingQueue())
+            resp = gw.DoOrderBatch(
+                pb.OrderBatchRequest(
+                    orders=[
+                        _req("u1", "a", "s", 0, 1.0, 1.0),
+                        _req("u2", "b", "s", 1, 1.0, 2.0),
+                    ]
+                ),
+                None,
+            )
+            responses.append(resp)
+            pools.append(pool)
+        rs, rc = responses
+        _assert_resp_equal(rs, rc)
+        assert rc.code == 14 and rc.accepted == 0  # retryable
+        assert "batch aborted at entry 0: broker down" in rc.message
+        assert pools[0] == pools[1] == set()
+
+    def test_columnar_rejects_beyond_i64_wire_range(self):
+        """Documented divergence (MIGRATION.md round 11): ticks that do
+        not fit the i64 wire columns are rejected at the edge on the
+        columnar path instead of crashing later in the encoder."""
+        gw, pool, bus = _make_gateway(True)
+        resp = gw.DoOrderBatch(
+            pb.OrderBatchRequest(
+                orders=[_req("u", "o", "s", 0, 1e15, 1.0)]  # 1e23 ticks
+            ),
+            None,
+        )
+        assert resp.accepted == 0 and list(resp.reject_index) == [0]
+        assert "64-bit wire range" in resp.rejects[0].message
+        assert not pool and not bus.order_queue.read_from(0, 10)
+
+
+# ---------------------------------------------------------------------------
+# FrameBatcher.submit_block and the mixed Order/block buffer.
+
+
+class TestBatcherBlocks:
+    def _block(self, orders: list[Order]):
+        frame = encode_orders(orders)
+        assert frame[:4] == ORDER_MAGIC  # untraced fixture only
+        return frame[4:], len(orders)
+
+    def test_submit_block_after_close_raises(self):
+        batcher = FrameBatcher(MemoryQueue("doOrder"), max_n=64, max_wait_s=60)
+        batcher.close()
+        block, n = self._block(FIXTURE[:3])
+        with pytest.raises(RuntimeError, match="closed; order not accepted"):
+            batcher.submit_block(block, n)
+
+    def test_submit_block_backpressure_when_spill_full(self):
+        batcher = FrameBatcher(
+            _FailingQueue(),
+            max_n=1000,
+            max_wait_s=60,
+            spill_max_frames=1,
+            retry_interval_s=60,
+        )
+        try:
+            batcher.submit(FIXTURE[0])
+            batcher.flush()  # frame lands in the spill (bus down)
+            assert batcher.stats()["spill_depth"] == 1
+            assert batcher.degraded
+            block, n = self._block(FIXTURE[:2])
+            with pytest.raises(Backpressure, match="spill full"):
+                batcher.submit_block(block, n)
+            with pytest.raises(Backpressure, match="spill full"):
+                batcher.submit(FIXTURE[1])
+        finally:
+            batcher.close()  # logs the undelivered spill, loudly
+
+    def test_mixed_buffer_flushes_runs_in_arrival_order(self):
+        queue = MemoryQueue("doOrder")
+        batcher = FrameBatcher(queue, max_n=10_000, max_wait_s=60)
+        try:
+            a1, a2, a3 = FIXTURE[0], FIXTURE[1], FIXTURE[2]
+            b1, n1 = self._block(FIXTURE[8:11])
+            b2, n2 = self._block(FIXTURE[11:13])
+            batcher.submit(a1)
+            batcher.submit(a2)
+            batcher.submit_block(b1, n1)
+            assert batcher.stats()["buffered"] == 2 + n1
+            batcher.submit(a3)
+            batcher.submit_block(b2, n2)
+            assert batcher.flush() == 3 + n1 + n2
+            msgs = queue.read_from(0, 10)
+            assert [m.body[:4] for m in msgs] == [
+                b"GCO2", b"GCO4", b"GCO2", b"GCO4"
+            ]
+            decoded = []
+            for m in msgs:
+                decoded.extend(
+                    orders_from_columns(decode_order_frame(m.body))
+                )
+            assert decoded == (
+                [a1, a2] + FIXTURE[8:11] + [a3] + FIXTURE[11:13]
+            )
+        finally:
+            batcher.close()
+
+    def test_consecutive_blocks_join_into_one_gco4_frame(self):
+        queue = MemoryQueue("doOrder")
+        batcher = FrameBatcher(queue, max_n=10_000, max_wait_s=60)
+        try:
+            b1, n1 = self._block(FIXTURE[:5])
+            b2, n2 = self._block(FIXTURE[5:7])
+            batcher.submit_block(b1, n1)
+            batcher.submit_block(b2, n2)
+            batcher.flush()
+            msgs = queue.read_from(0, 10)
+            assert len(msgs) == 1
+            n_total, n_blocks = struct.unpack_from("<II", msgs[0].body, 4)
+            assert (n_total, n_blocks) == (n1 + n2, 2)
+            assert (
+                orders_from_columns(decode_order_frame(msgs[0].body))
+                == FIXTURE[:7]
+            )
+        finally:
+            batcher.close()
+
+    def test_block_counts_trip_the_size_bound(self):
+        queue = MemoryQueue("doOrder")
+        batcher = FrameBatcher(queue, max_n=4, max_wait_s=60)
+        try:
+            block, n = self._block(FIXTURE[:5])  # 5 orders >= max_n=4
+            batcher.submit_block(block, n)
+            msgs = queue.read_from(0, 10)  # flushed on the submit itself
+            assert len(msgs) == 1 and msgs[0].body[:4] == b"GCO4"
+            assert batcher.stats()["buffered"] == 0
+        finally:
+            batcher.close()
