@@ -1,14 +1,21 @@
 """Cancel client — behavioral port of gomengine/delorder.go:14-38: one
 DeleteOrder for a hardcoded order (uuid="2", oid="11", price=0.5,
 delorder.go:30-36). The cancel contract requires the exact resting price
-(SURVEY §2.3.2)."""
+(SURVEY §2.3.2). Retryable (code 14) responses — overloaded or degraded
+gateway — are retried under decorrelated-jitter backoff like the load
+client, honoring the server's retry-after hint."""
 
 from __future__ import annotations
+
+import random
+import time
 
 import grpc
 
 from ..api import order_pb2 as pb
 from ..api.service import OrderStub
+from ..utils.resilience import BackoffPolicy, backoff_delays
+from .doorder import CODE_RETRYABLE, RETRY_AFTER_RE
 
 
 def cancel_client(
@@ -19,19 +26,32 @@ def cancel_client(
     transaction: int = 0,
     price: float = 0.5,
     volume: float = 1.0,
+    policy: BackoffPolicy | None = None,
+    sleep=time.sleep,
 ) -> pb.OrderResponse:
+    delays = backoff_delays(policy or BackoffPolicy(), random.Random())
     with grpc.insecure_channel(target) as channel:
         stub = OrderStub(channel)
-        return stub.DeleteOrder(
-            pb.OrderRequest(
-                uuid=uuid,
-                oid=oid,
-                symbol=symbol,
-                transaction=transaction,
-                price=price,
-                volume=volume,
+        while True:
+            resp = stub.DeleteOrder(
+                pb.OrderRequest(
+                    uuid=uuid,
+                    oid=oid,
+                    symbol=symbol,
+                    transaction=transaction,
+                    price=price,
+                    volume=volume,
+                )
             )
-        )
+            if resp.code != CODE_RETRYABLE:
+                return resp
+            m = RETRY_AFTER_RE.search(resp.message or "")
+            hint = float(m.group(1)) if m else 0.0
+            try:
+                delay = next(delays)
+            except StopIteration:  # budget exhausted: surface the 14
+                return resp
+            sleep(max(delay, hint))
 
 
 def main(argv=None):

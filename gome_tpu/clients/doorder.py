@@ -12,12 +12,79 @@ from __future__ import annotations
 
 import collections
 import random
+import re
 import time
 
 import grpc
 
 from ..api import order_pb2 as pb
 from ..api.service import OrderStub
+from ..utils.resilience import BackoffPolicy, backoff_delays
+
+#: gateway retryable status (service.gateway.CODE_RETRYABLE): the
+#: remainder was NOT accepted and a later retry should succeed.
+CODE_RETRYABLE = 14
+
+#: retry-after hint embedded in retryable reject messages by the
+#: admission controller (service.admission.RETRY_AFTER_FMT).
+RETRY_AFTER_RE = re.compile(r"retry-after=([0-9.]+)s")
+
+
+def send_batch_retrying(
+    send,
+    orders: list,
+    cancel: list | None = None,
+    policy: BackoffPolicy | None = None,
+    rng: random.Random | None = None,
+    sleep=time.sleep,
+) -> dict:
+    """Submit one logical batch through `send(orders, cancel) -> resp`,
+    retrying the unconsumed remainder whenever the gateway answers the
+    retryable status (code 14: overloaded / degraded) instead of failing
+    the batch outright.
+
+    The consumed prefix of an aborted batch is exactly
+    `resp.accepted + len(resp.reject_index)` (every entry before the
+    abort point was either accepted or per-entry rejected — the
+    gateway's remainder contract), so a retry resubmits only the tail:
+    at-most-once per entry, no duplicates. Waits combine the server's
+    parsed retry-after hint with decorrelated jitter from
+    utils.resilience (`max(hint, jitter)` — the hint is a floor, the
+    jitter de-synchronizes the retrying herd). A non-retryable code or
+    an exhausted retry budget leaves the tail in `aborted`.
+
+    Returns {ok, rejected, aborted, retries}."""
+    policy = policy or BackoffPolicy()
+    delays = backoff_delays(policy, rng or random.Random())
+    ok = rejected = retries = aborted = 0
+    while orders:
+        resp = send(orders, cancel)
+        consumed = resp.accepted + len(resp.reject_index)
+        ok += resp.accepted
+        rejected += len(resp.reject_index)
+        if resp.code != CODE_RETRYABLE:
+            # 0 = fully applied (consumed == len); 3 = permanent abort,
+            # the tail is counted, never silently resubmitted.
+            aborted += len(orders) - consumed
+            break
+        orders = orders[consumed:]
+        if cancel:
+            cancel = cancel[consumed:]
+        if not orders:
+            break
+        m = RETRY_AFTER_RE.search(resp.message or "")
+        hint = float(m.group(1)) if m else 0.0
+        try:
+            delay = next(delays)
+        except StopIteration:  # retry budget exhausted — fail loudly
+            aborted += len(orders)
+            break
+        retries += 1
+        sleep(max(delay, hint))
+    return {
+        "ok": ok, "rejected": rejected, "aborted": aborted,
+        "retries": retries,
+    }
 
 
 def load_client(
@@ -59,7 +126,7 @@ def load_client(
                 kind=kind,
             )
 
-    sent = ok = rejected = aborted = 0
+    sent = ok = rejected = aborted = retried = 0
     window = max(1, concurrency)
     with grpc.insecure_channel(target) as channel:
         stub = OrderStub(channel)
@@ -68,17 +135,36 @@ def load_client(
         if batch_n > 0:
             import itertools
 
-            def settle(f, n_chunk):
-                nonlocal ok, rejected, aborted
+            retry_rng = random.Random(seed)
+
+            def send(orders, cancel):
+                return stub.DoOrderBatch(pb.OrderBatchRequest(orders=orders))
+
+            def settle(f, chunk):
+                nonlocal ok, rejected, aborted, retried
                 resp = f.result()
                 ok += resp.accepted
                 rejected += len(resp.reject_index)
+                consumed = resp.accepted + len(resp.reject_index)
+                if resp.code == CODE_RETRYABLE and consumed < len(chunk):
+                    # Overloaded / degraded gateway: honor the retryable
+                    # status — resubmit the unconsumed tail under
+                    # decorrelated-jitter backoff (synchronously; the
+                    # stall IS the backpressure reaching this client).
+                    r = send_batch_retrying(
+                        send, chunk[consumed:], rng=retry_rng
+                    )
+                    ok += r["ok"]
+                    rejected += r["rejected"]
+                    aborted += r["aborted"]
+                    retried += r["retries"]
+                    return
                 # A code-3 mid-batch abort (batcher closed, bus down)
                 # leaves a tail that was neither accepted nor
                 # per-order-rejected; count it so sent == ok + rejected
                 # + aborted always holds and failures surface HERE, not
                 # as an opaque downstream count mismatch.
-                aborted += n_chunk - resp.accepted - len(resp.reject_index)
+                aborted += len(chunk) - consumed
 
             reqs = requests()
             while True:
@@ -92,12 +178,12 @@ def load_client(
                         stub.DoOrderBatch.future(
                             pb.OrderBatchRequest(orders=chunk)
                         ),
-                        len(chunk),
+                        chunk,
                     )
                 )
                 sent += len(chunk)
-            for f, n_chunk in pending:
-                settle(f, n_chunk)
+            for f, chunk in pending:
+                settle(f, chunk)
         else:
             # One loop for both unary modes: a window of 1 sends
             # request-after-response, exactly the reference's serial
@@ -121,6 +207,7 @@ def load_client(
         "ok": ok,
         "rejected": rejected,
         "aborted": aborted,  # batch entries lost to a mid-batch abort
+        "retried": retried,  # code-14 retry rounds (backpressure honored)
         "elapsed_s": elapsed,
         "orders_per_s": sent / elapsed if elapsed > 0 else 0.0,
     }

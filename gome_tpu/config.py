@@ -436,6 +436,39 @@ class FaultsConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AdmissionConfig:
+    """Gateway admission control (service.admission) — depth/deadline
+    load shedding with retryable status + retry-after hint (round 12).
+    Off by default: without an `admission:` section the gateway admits
+    unconditionally, exactly the pre-round-12 behavior."""
+
+    enabled: bool = False
+    #: shed (code 14) once order-queue consumer lag reaches this many
+    #: orders — bounds worst-case queueing delay at max_depth/drain-rate.
+    max_depth: int = 16384
+    #: shed requests whose remaining gRPC deadline is below this (s);
+    #: 0 disables the deadline check.
+    min_deadline_s: float = 0.0
+    #: retry-after hint at the ceiling (s); scales with overshoot.
+    retry_after_s: float = 0.05
+    retry_after_max_s: float = 2.0
+    #: consumer-lag sample cache window (s) — admission is per-RPC.
+    cache_s: float = 0.005
+
+    def __post_init__(self) -> None:
+        if self.max_depth < 1:
+            raise ValueError("admission.max_depth must be >= 1")
+        if self.min_deadline_s < 0:
+            raise ValueError("admission.min_deadline_s must be >= 0")
+        if self.retry_after_s <= 0:
+            raise ValueError("admission.retry_after_s must be positive")
+        if self.retry_after_max_s < self.retry_after_s:
+            raise ValueError(
+                "admission.retry_after_max_s must be >= retry_after_s"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     grpc: GrpcConfig = GrpcConfig()
     store: StoreConfig = StoreConfig()
@@ -446,6 +479,7 @@ class Config:
     fleet: FleetConfig = FleetConfig()
     sim: SimConfig = SimConfig()
     faults: FaultsConfig = FaultsConfig()
+    admission: AdmissionConfig = AdmissionConfig()
 
 
 _C = TypeVar("_C")
@@ -502,11 +536,14 @@ def load_config(path: str | None = None) -> Config:
     faults_raw = dict(raw.get("faults", {}) or {})
     if faults_raw:
         faults_raw.setdefault("enabled", True)
+    admission_raw = dict(raw.get("admission", {}) or {})
+    if admission_raw:
+        admission_raw.setdefault("enabled", True)
     raw.pop("mysql", None)  # dead section, config.yaml.example:16-21
 
     known = {
         "grpc", "redis", "rabbitmq", "bus", "gomengine", "engine",
-        "persist", "ops", "fleet", "sim", "faults",
+        "persist", "ops", "fleet", "sim", "faults", "admission",
     }
     unknown = set(raw) - known
     if unknown:
@@ -522,4 +559,5 @@ def load_config(path: str | None = None) -> Config:
         fleet=_build(FleetConfig, fleet_raw, "fleet"),
         sim=_build(SimConfig, sim_raw, "sim"),
         faults=_build(FaultsConfig, faults_raw, "faults"),
+        admission=_build(AdmissionConfig, admission_raw, "admission"),
     )

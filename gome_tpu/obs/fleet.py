@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -249,6 +250,9 @@ class FleetAggregator:
         self._fetch = _default_fetch  # single-writer: install() caller
         self._registry: Registry = REGISTRY  # single-writer: install()/disable() caller
         self._last: dict = {}  # guarded by self._lock — latest member snapshots
+        self._last_ok: dict = {}  # guarded by self._lock — per-member last fully-successful poll (clock time)
+        self.stale_after_s = 3.0  # single-writer: install() caller
+        self._clock = time.monotonic  # single-writer: install() caller
         self._polls = 0  # guarded by self._lock
         self._unhealthy_polls = 0  # guarded by self._lock
         self._degraded_polls = 0  # guarded by self._lock
@@ -270,19 +274,36 @@ class FleetAggregator:
         timeout_s: float = 2.0,
         registry: Registry | None = None,
         fetch=None,
+        stale_after_s: float | None = None,
+        clock=None,
     ) -> "FleetAggregator":
         """Arm the aggregator over `members` ({name: base URL of that
         process's ops server}). `fetch` is injectable for tests (a
         callable ``(url, timeout_s) -> str``); `registry` receives the
-        ``gome_fleet_*`` gauges (process REGISTRY by default)."""
+        ``gome_fleet_*`` gauges (process REGISTRY by default).
+        `stale_after_s` bounds how old a member's last successful poll
+        may be before it is surfaced as STALE/down (default 3x the poll
+        interval — one missed sweep is noise, three is an outage);
+        `clock` is injectable for the staleness tests."""
         if not members:
             raise ValueError("fleet members must be a non-empty {name: url}")
         if interval_s <= 0:
             raise ValueError(f"interval_s must be positive, got {interval_s}")
         if timeout_s <= 0:
             raise ValueError(f"timeout_s must be positive, got {timeout_s}")
+        if stale_after_s is not None and stale_after_s <= 0:
+            raise ValueError(
+                f"stale_after_s must be positive, got {stale_after_s}"
+            )
         self.interval_s = float(interval_s)
         self.timeout_s = float(timeout_s)
+        self.stale_after_s = (
+            float(stale_after_s)
+            if stale_after_s is not None
+            else 3.0 * self.interval_s
+        )
+        if clock is not None:
+            self._clock = clock
         if fetch is not None:
             self._fetch = fetch
         if registry is not None:
@@ -292,6 +313,7 @@ class FleetAggregator:
                 str(k): str(v).rstrip("/") for k, v in members.items()
             }
             self._last = {}
+            self._last_ok = {}
             self._polls = 0
             self._unhealthy_polls = 0
             self._degraded_polls = 0
@@ -307,12 +329,14 @@ class FleetAggregator:
         with self._lock:
             self._members = None
             self._last = {}
+            self._last_ok = {}
             self._polls = 0
             self._unhealthy_polls = 0
             self._degraded_polls = 0
             self._fetch_errors = 0
         self._fetch = _default_fetch
         self._registry = REGISTRY
+        self._clock = time.monotonic
 
     # -- polling -----------------------------------------------------------
     def poll(self) -> dict | None:
@@ -327,6 +351,7 @@ class FleetAggregator:
         n_unhealthy = sum(1 for m in snap.values() if not m["healthy"])
         n_degraded = sum(1 for m in snap.values() if m["degraded"])
         n_errors = sum(1 for m in snap.values() if m["error"] is not None)
+        now = self._clock()
         with self._lock:
             if self._members is None:  # disabled between check and lock
                 return None
@@ -336,8 +361,29 @@ class FleetAggregator:
             if n_degraded:
                 self._degraded_polls += 1
             self._fetch_errors += n_errors
+            for name, st in snap.items():
+                if st["error"] is None:
+                    self._last_ok[name] = now
             self._last = snap
         return snap
+
+    # -- member liveness (round 12) ----------------------------------------
+    def poll_age_s(self, name: str) -> float | None:
+        """Seconds since `name`'s last fully-successful scrape, or None
+        if it has never been scraped successfully."""
+        t = self._last_ok.get(name)  # gomelint: disable=GL402 — stale read OK
+        return None if t is None else max(self._clock() - t, 0.0)
+
+    def member_up(self, name: str) -> bool:
+        """True while `name`'s latest scrape succeeded AND is fresh
+        (poll age within stale_after_s) — the gome_fleet_member_up
+        gauge value. An unreachable or stale member reads 0, never a
+        silently-served stale merge."""
+        st = self._last.get(name)  # gomelint: disable=GL402 — stale read OK
+        if st is None or st["error"] is not None:
+            return False
+        age = self.poll_age_s(name)
+        return age is not None and age <= self.stale_after_s
 
     def _scrape_member(self, url: str) -> dict:
         """One member's /healthz + /metrics + /durability + /timeline,
@@ -456,7 +502,11 @@ class FleetAggregator:
         exps: dict[str, dict] = {}
         seq_procs: dict[str, dict] = {}
         timeline: dict[str, list] = {}
+        unreachable = []
         for name, st in snap.items():
+            up = self.member_up(name)
+            age = self.poll_age_s(name)
+            stale = age is None or age > self.stale_after_s
             members_out[name] = {
                 "url": st["url"],
                 "healthy": st["healthy"],
@@ -464,7 +514,12 @@ class FleetAggregator:
                 "error": st["error"],
                 "health": st["health"],
                 "seq": st["seq"],
+                "up": up,
+                "poll_age_s": age,
+                "stale": stale,
             }
+            if not up:
+                unreachable.append(name)
             if st["families"] is not None:
                 exps[name] = st["families"]
             if isinstance(st["seq"], dict):
@@ -488,6 +543,11 @@ class FleetAggregator:
         return {
             "enabled": True,
             "members": members_out,
+            # Members whose latest scrape failed or went stale — callers
+            # (and the fleet drill verdict) see explicitly WHOSE data is
+            # missing from the merge instead of a silently thinner view.
+            "unreachable": sorted(unreachable),
+            "stale_after_s": self.stale_after_s,
             "rollup": self.rollup(),
             "metrics": metrics,
             "seq": {"procs": seq_procs, "fleet": fleet_seq},
@@ -526,6 +586,17 @@ class FleetAggregator:
             "member endpoint fetches that failed",
             lambda: self._fetch_errors,  # gomelint: disable=GL402
         )
+        # Per-member liveness: one labeled child per member name (the
+        # member set is fixed at install time). 1 = latest scrape
+        # succeeded and is fresh; 0 = unreachable or stale.
+        for name in (self._members or {}):  # gomelint: disable=GL402
+            registry.callback_gauge(
+                "gome_fleet_member_up",
+                "1 while the member's latest poll succeeded and is fresh "
+                "(within stale_after_s); 0 = unreachable or stale",
+                (lambda n: lambda: float(self.member_up(n)))(name),
+                labels={"proc": name},
+            )
 
 
 #: Process-global aggregator (disabled until something installs a member

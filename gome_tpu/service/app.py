@@ -123,6 +123,23 @@ class EngineService:
             persist.export_metrics()
         from ..engine.step import LOT_MAX32
 
+        self.admission = None
+        if self.config.admission.enabled:
+            # End-to-end overload protection (round 12): the gateway
+            # sheds retryable once order-queue consumer lag crosses the
+            # configured ceiling — backpressure reaches the client
+            # instead of piling into the bus.
+            from .admission import AdmissionController
+
+            a = self.config.admission
+            self.admission = AdmissionController(
+                self.bus.order_queue.depth,
+                max_depth=a.max_depth,
+                min_deadline_s=a.min_deadline_s,
+                retry_after_s=a.retry_after_s,
+                retry_after_max_s=a.retry_after_max_s,
+                cache_s=a.cache_s,
+            )
         self.gateway = OrderGateway(
             self.bus,
             accuracy=e.accuracy,
@@ -132,6 +149,7 @@ class EngineService:
             unmark_frame=self.engine.unmark_frame,
             match_feed=self.feed,
             max_volume=LOT_MAX32 if e.dtype == "int32" else None,
+            admission=self.admission,
         )
         self._server = None
         self.ops = None

@@ -78,7 +78,21 @@ class FrameBatcher:
         max_wait_s: float = 0.002,
         spill_max_frames: int = 64,
         retry_interval_s: float = 0.05,
+        min_n: int | None = None,
+        depth_fn=None,
+        depth_low: int = 256,
+        depth_high: int = 8192,
+        resize_interval_s: float = 0.05,
     ):
+        """min_n + depth_fn arm ADAPTIVE frame sizing (round 12): the
+        size bound interpolates between min_n (consumer lag <= depth_low
+        — queues shallow, close frames early for latency) and max_n
+        (lag >= depth_high — backed up, amortize hard for throughput).
+        depth_fn is the consumer-lag read (bus.order_queue.depth); it is
+        sampled at most every resize_interval_s, off the per-submit hot
+        path. Omit either and the bound is the fixed max_n of rounds
+        <= 11. The latency bound (max_wait_s) is never adapted — it is
+        the explicit worst-case promise."""
         if max_n < 1:
             raise ValueError("max_n must be >= 1")
         if spill_max_frames < 1:
@@ -88,6 +102,21 @@ class FrameBatcher:
         self.max_wait_s = max_wait_s
         self.spill_max_frames = spill_max_frames
         self.retry_interval_s = retry_interval_s
+        if min_n is not None and depth_fn is not None:
+            if not (1 <= min_n <= max_n):
+                raise ValueError("need 1 <= min_n <= max_n")
+            if not (0 <= depth_low < depth_high):
+                raise ValueError("need 0 <= depth_low < depth_high")
+            self._adaptive = True
+        else:
+            self._adaptive = False
+        self.min_n = min_n if self._adaptive else max_n
+        self._depth_fn = depth_fn
+        self.depth_low = depth_low
+        self.depth_high = depth_high
+        self.resize_interval_s = resize_interval_s
+        self._eff_n = max_n if not self._adaptive else min_n  # guarded by self._lock
+        self._eff_at = -1.0  # guarded by self._lock
         # Mixed buffer: scalar handlers append Order objects, the columnar
         # admit core appends pre-encoded wire BLOCKS (bytes) via
         # submit_block — flushing walks contiguous runs so arrival order
@@ -120,6 +149,12 @@ class FrameBatcher:
             "orders buffered in the batcher awaiting a frame flush "
             "(the batching-bridge queue depth)",
             lambda: self._buf_n,  # gomelint: disable=GL402 — see above
+        )
+        REGISTRY.callback_gauge(
+            "gome_gateway_frame_target",
+            "current effective frame-size bound (adaptive sizing; equals "
+            "max_n when the adaptive bridge is not armed)",
+            lambda: self._eff_n,  # gomelint: disable=GL402 — see above
         )
         REGISTRY.callback_gauge(
             "gome_gateway_degraded_seconds",
@@ -157,7 +192,40 @@ class FrameBatcher:
                 spill_depth=len(self._spill),
                 spill_max_frames=self.spill_max_frames,
                 buffered=self._buf_n,
+                effective_max_n=self._eff_n,
+                adaptive=self._adaptive,
             )
+
+    def effective_max_n(self) -> int:
+        """Current frame-size bound; recomputes the adaptive target when
+        the sample window expired (public for tests/ops introspection)."""
+        with self._lock:
+            return self._effective_locked()
+
+    def _effective_locked(self) -> int:  # gomelint: hotpath
+        """Frame-size bound under self._lock. Adaptive mode linearly
+        interpolates min_n..max_n over the depth_low..depth_high lag
+        band, sampling depth_fn at most every resize_interval_s; the
+        result is always clamped to [min_n, max_n] even against a
+        misbehaving depth_fn (negative / NaN-ish readings)."""
+        if not self._adaptive:
+            return self.max_n
+        now = time.monotonic()
+        if now - self._eff_at >= self.resize_interval_s:
+            self._eff_at = now
+            try:
+                depth = int(self._depth_fn())
+            except Exception:
+                # A broken lag probe must never stall admission; fall
+                # back to the throughput-safe bound.
+                depth = self.depth_high
+            frac = (depth - self.depth_low) / (
+                self.depth_high - self.depth_low
+            )
+            frac = min(max(frac, 0.0), 1.0)
+            eff = round(self.min_n + frac * (self.max_n - self.min_n))
+            self._eff_n = min(max(eff, self.min_n), self.max_n)
+        return self._eff_n
 
     def submit(self, order: Order) -> None:  # gomelint: hotpath
         """Buffer one accepted order; flush if the size bound tripped.
@@ -191,7 +259,7 @@ class FrameBatcher:
                 self._wake.set()
             self._buf.append(order)
             self._buf_n += 1
-            if self._buf_n >= self.max_n:
+            if self._buf_n >= self._effective_locked():
                 self._flush_locked()
 
     def submit_block(self, block: bytes, n: int) -> None:  # gomelint: hotpath
@@ -216,7 +284,7 @@ class FrameBatcher:
                 self._wake.set()
             self._buf.append(block)
             self._buf_n += n
-            if self._buf_n >= self.max_n:
+            if self._buf_n >= self._effective_locked():
                 self._flush_locked()
 
     def flush(self) -> int:

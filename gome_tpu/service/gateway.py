@@ -31,6 +31,7 @@ from ..config import Config
 from ..fixed import scale
 from ..obs.hostprof import HOSTPROF
 from ..types import Action, Order, OrderType, Side
+from ..utils.faults import FAULTS
 from ..utils.logging import get_logger
 from ..utils.trace import TRACER
 
@@ -43,6 +44,21 @@ log = get_logger("gateway")
 #: succeed. 14 matches gRPC UNAVAILABLE by convention.
 CODE_REJECT = 3
 CODE_RETRYABLE = 14
+
+
+def _time_remaining(context) -> float | None:
+    """Caller's remaining gRPC deadline in seconds, or None when no
+    deadline was set (or the test harness passed a bare context)."""
+    if context is None:
+        return None
+    tr = getattr(context, "time_remaining", None)
+    if not callable(tr):
+        return None
+    remaining = tr()
+    # grpc returns a huge sentinel (~year-scale) when no deadline is set.
+    if remaining is None or remaining > 1e8:
+        return None
+    return remaining
 
 
 def order_from_request(
@@ -163,6 +179,7 @@ class OrderGateway:
         mark_frame=None,
         unmark_frame=None,
         columnar: bool = True,
+        admission=None,
     ):
         """mark: callable(Order) recording the pre-pool entry — the
         MatchEngine.mark bound method in single-binary mode. match_feed:
@@ -182,7 +199,11 @@ class OrderGateway:
         batched pre-pool marker; when absent the columnar path falls back
         to per-order mark/unmark over materialized Orders. columnar: admit
         DoOrderBatch/DoOrderStream traffic through the array-native core
-        (False pins the per-entry scalar loop, e.g. for parity tests)."""
+        (False pins the per-entry scalar loop, e.g. for parity tests).
+        admission: a service.admission.AdmissionController — handlers
+        consult it BEFORE marking/emitting; a shed returns the retryable
+        status (code 14) with a retry-after hint, so backed-up consumers
+        push backpressure all the way to the client."""
         self._bus = bus
         self._accuracy = accuracy
         self._mark = mark or (lambda order: None)
@@ -193,8 +214,13 @@ class OrderGateway:
         self._match_feed = match_feed
         self._max_volume = max_volume
         self._batcher = batcher
+        self._admission = admission
 
     def _emit(self, order: Order) -> None:
+        # Fault point "gateway.emit": exit = gateway-kill, call-handler
+        # raising ConnectionError = bus-disconnect — both exercised by
+        # scripts/fleet_chaos.py against the real degraded paths below.
+        FAULTS.fire("gateway.emit")
         if self._batcher is not None:
             self._batcher.submit(order)
         elif order.trace is not None and self._bus.order_queue.supports_headers:
@@ -247,6 +273,12 @@ class OrderGateway:
         return order
 
     def DoOrder(self, request: pb.OrderRequest, context) -> pb.OrderResponse:
+        if self._admission is not None:
+            d = self._admission.admit(1, _time_remaining(context))
+            if not d.ok:
+                return pb.OrderResponse(
+                    code=CODE_RETRYABLE, message=d.message()
+                )
         tid, t0 = self._begin_trace()
         try:
             order = self._validate_add(request)
@@ -275,6 +307,12 @@ class OrderGateway:
         return pb.OrderResponse(code=0, message="order accepted")
 
     def DeleteOrder(self, request: pb.OrderRequest, context) -> pb.OrderResponse:
+        if self._admission is not None:
+            d = self._admission.admit(1, _time_remaining(context))
+            if not d.ok:
+                return pb.OrderResponse(
+                    code=CODE_RETRYABLE, message=d.message()
+                )
         tid, t0 = self._begin_trace()
         try:
             order = order_from_request(request, Action.DEL, self._accuracy)
@@ -415,6 +453,7 @@ class OrderGateway:
                 self._unmark(order)
 
     def _emit_cols(self, cols: dict, m: int) -> None:  # gomelint: hotpath
+        FAULTS.fire("gateway.emit")  # same point as the scalar funnel
         block = encode_order_block(
             m,
             cols["action"],
@@ -544,6 +583,15 @@ class OrderGateway:
                     f"orders length {n}"
                 ),
             )
+        if self._admission is not None and n:
+            # One verdict for the whole batch (all-or-nothing shed:
+            # accepted=0, the client resubmits after the hint — the same
+            # remainder contract as a batch abort at entry 0).
+            d = self._admission.admit(n, _time_remaining(context))
+            if not d.ok:
+                return pb.OrderBatchResponse(
+                    code=CODE_RETRYABLE, message=d.message()
+                )
         if self._columnar and not TRACER.enabled and n:
             # Array-native core; per-order trace journeys need the scalar
             # loop (each entry gets its own trace id + wire context).
@@ -580,6 +628,9 @@ class OrderGateway:
         for request in request_iterator:
             chunk.append(request)
             if len(chunk) >= STREAM_CHUNK:
+                if not self._admit_stream_chunk(resp, len(chunk), context):
+                    resp.accepted = accepted
+                    return resp
                 accepted += self._apply_columnar(
                     chunk, np.zeros(len(chunk), np.bool_), resp, base=base
                 )
@@ -589,11 +640,27 @@ class OrderGateway:
                 base += len(chunk)
                 chunk = []
         if chunk:
+            if not self._admit_stream_chunk(resp, len(chunk), context):
+                resp.accepted = accepted
+                return resp
             accepted += self._apply_columnar(
                 chunk, np.zeros(len(chunk), np.bool_), resp, base=base
             )
         resp.accepted = accepted
         return resp
+
+    def _admit_stream_chunk(self, resp, n: int, context) -> bool:
+        """Admission verdict per stream chunk — a shed aborts the stream
+        with the retryable status and accepted = rows admitted by the
+        chunks that made it (the established remainder contract)."""
+        if self._admission is None:
+            return True
+        d = self._admission.admit(n, _time_remaining(context))
+        if d.ok:
+            return True
+        resp.code = CODE_RETRYABLE
+        resp.message = d.message()
+        return False
 
     def SubscribeMatches(self, request: pb.SubscribeRequest, context):
         if self._match_feed is None:

@@ -18,17 +18,21 @@ Topology (parent drives everything; 5 children):
     ------                              -----------------------------
     record sim GCO frames               respserver (RESP marker store)
     decode -> per-order requests        gw0, gw1: OrderGateway + gRPC
-    route by crc32(symbol) % 2              + ops server (file bus p{i})
+    route via fleet.partition_of            + ops server (file bus p{i})
     drive both partitions over gRPC     c0, c1: full EngineService
     FLEET polls all 4 ops servers           (consumer + matchfeed + ops)
     drain via /durability; stitch
     journeys; audit seqs; verdict
 
-Partitioning is CONFIG-LEVEL: the driver routes each order's symbol with
-a stable hash to one of two disjoint (bus dir, queue, store namespace)
-partitions. No consistent-hashing subsystem exists or is implied — the
-point is that N independent single-partition deployments plus the
-aggregator ARE a fleet.
+Partitioning rides the fleet router tier (gome_tpu.fleet, round 12):
+`fleet.partition_of` is the consistent fnv1a symbol hash every layer of
+the tree shares (parallel/router.py in-process, the fleet PartitionMap
+across members), so the drill's routing and the failover drill's routing
+are the SAME function — N independent single-partition deployments plus
+the aggregator ARE a fleet. The verdict's imbalance row records how
+evenly that hash spread this run's symbols (a skewed draw is a property
+of the symbol set, not a routing bug — the explicit PartitionMap is the
+rebalance lever).
 
 The verdict JSON (committed as FLEET_r01.json, pinned by
 tests/test_fleet.py) records the aggregate throughput table (per-proc
@@ -47,7 +51,6 @@ import subprocess
 import sys
 import threading
 import time
-import zlib
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -66,10 +69,15 @@ T_BINS = 8
 
 
 def partition_of(symbol: str) -> int:
-    """Stable symbol -> partition routing (driver-side config, not a
-    subsystem): every process in the fleet that needs it can recompute
-    it from the symbol alone."""
-    return zlib.crc32(symbol.encode()) % N_PARTITIONS
+    """Stable symbol -> partition routing via the fleet router tier
+    (gome_tpu.fleet.partition_of, fnv1a): every process in the fleet can
+    recompute it from the symbol alone, and it is the SAME mapping the
+    failover drill's PartitionMap assigns members over. Lazy import —
+    the module body must not import gome_tpu before JAX_PLATFORMS is
+    pinned."""
+    from gome_tpu.fleet import partition_of as _partition_of
+
+    return _partition_of(symbol, N_PARTITIONS)
 
 
 def rusage_self() -> dict:
@@ -625,6 +633,7 @@ def run_parent(args) -> int:
             "feed": results.get(con, {}).get("feed"),
             "rusage": results.get(con, {}).get("rusage"),
         }
+    part_counts = [len(p) for p in parts]
     table = {
         "drive_wall_s": round(drive_wall, 3),
         "warmup_orders": warm_n,
@@ -632,6 +641,17 @@ def run_parent(args) -> int:
         "fleet": {
             "orders": n_measured,
             "orders_per_sec": round(n_measured / drive_wall, 1),
+        },
+        # Routing-skew row (round 12): how evenly fleet.partition_of
+        # spread this run's order flow. FLEET_r01 under crc32 showed a
+        # 3.7x skew (625 vs 169); the row makes the spread a first-class
+        # reviewed number instead of an accident buried in config.
+        "imbalance": {
+            "orders_per_partition": part_counts,
+            "symbols_per_partition": sym_counts,
+            "max_over_min_orders": round(
+                max(part_counts) / max(1, min(part_counts)), 2
+            ),
         },
         "e2e_latency_ms": {
             "samples": len(lat_all),

@@ -311,6 +311,35 @@ def fleet_advisory() -> dict:
         return {"fleet.advisory_error": f"{type(exc).__name__}: {exc}"}
 
 
+def fleet_chaos_advisory() -> dict:
+    """Fleet fault-tolerance surface (round 12), ADVISORY only —
+    wall-clock (never gated; a shared CI box cannot hold a recovery
+    SLO, the machine-checked bound lives in the fleet_chaos job).
+
+    Sourced from the committed fleet chaos verdict (FLEET_CHAOS_r01.json
+    at the repo root, regenerated by scripts/fleet_chaos.py): member
+    recovery-time p50 across the kill/restart cycles, the worst degraded
+    window's aggregate throughput while a member was down, and the
+    verdict outcome."""
+    try:
+        path = os.path.join(ROOT, "FLEET_CHAOS_r01.json")
+        with open(path) as f:
+            verdict = json.load(f)
+        windows = verdict["throughput"]["degraded_windows"]
+        worst = min(w["orders_per_s"] for w in windows.values())
+        return {
+            "fleet_chaos.recovery_p50_s": verdict["recovery"]["p50_s"],
+            "fleet_chaos.degraded_orders_per_s_min": worst,
+            "fleet_chaos.throughput_floor": (
+                verdict["throughput"]["floor_orders_per_s"]
+            ),
+            "fleet_chaos.kills": verdict["config"]["kills"],
+            "fleet_chaos.verdict_pass": bool(verdict["pass"]),
+        }
+    except Exception as exc:  # pragma: no cover - env-specific
+        return {"fleet_chaos.advisory_error": f"{type(exc).__name__}: {exc}"}
+
+
 def collect() -> dict:
     """{"jax": version, "gated": {...}, "advisory": {...}}."""
     import jax
@@ -328,6 +357,7 @@ def collect() -> dict:
     advisory.update(gateway_advisory())
     advisory.update(recovery_advisory())
     advisory.update(fleet_advisory())
+    advisory.update(fleet_chaos_advisory())
     return {
         "jax": jax.__version__,
         "gated": gated,

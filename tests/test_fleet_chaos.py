@@ -1,0 +1,675 @@
+"""Fleet fault tolerance (round 12): partition routing, health gating,
+exactly-once failover, gateway admission control, adaptive frame
+sizing, client retry of the retryable status — and the committed
+FLEET_CHAOS_r01 verdict pin.
+
+Unit layers first (pure router math, the claim/recover/commit protocol,
+admission thresholds, batcher interpolation), then the PR 11
+deterministic interleaver driving the failover claim race across seeded
+schedules, then the pinned multi-process chaos verdict.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from gome_tpu.analysis.interleave import Interleaver, SteppingLock
+from gome_tpu.clients.doorder import (
+    CODE_RETRYABLE,
+    RETRY_AFTER_RE,
+    send_batch_retrying,
+)
+from gome_tpu.fleet.router import (
+    FailoverController,
+    HealthGate,
+    PartitionMap,
+    PartitionRouter,
+    RouteUnavailable,
+    partition_of,
+)
+from gome_tpu.obs.fleet import FleetAggregator
+from gome_tpu.service.admission import AdmissionController, Decision
+from gome_tpu.service.batcher import FrameBatcher
+from gome_tpu.types import Action, Order, Side
+from gome_tpu.utils.metrics import Registry
+from gome_tpu.utils.resilience import BackoffPolicy
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# partition_of / PartitionMap
+
+
+def test_partition_of_stable_and_validates():
+    # Stable across calls (fnv1a, not salted hash()) and in range.
+    for sym in ("eth2usdt", "btc2usdt", "sol2usdt", "", "x" * 64):
+        p = partition_of(sym, 4)
+        assert 0 <= p < 4
+        assert partition_of(sym, 4) == p
+    with pytest.raises(ValueError):
+        partition_of("eth2usdt", 0)
+
+
+def test_partition_map_validation():
+    with pytest.raises(ValueError, match="unassigned"):
+        PartitionMap(2, {0: "m0"})
+    with pytest.raises(ValueError, match="out of range"):
+        PartitionMap(1, {0: "m0", 1: "m1"})
+    with pytest.raises(ValueError, match="empty member"):
+        PartitionMap(1, {0: ""})
+    with pytest.raises(ValueError):
+        PartitionMap(0, {})
+    with pytest.raises(ValueError, match="at least one member"):
+        PartitionMap.even(2, [])
+
+
+def test_partition_map_even_and_reassign_bumps_epoch():
+    pmap = PartitionMap.even(4, ["m0", "m1"])
+    assert pmap.epoch == 0
+    assert pmap.partitions_of("m0") == [0, 2]
+    assert pmap.partitions_of("m1") == [1, 3]
+    assert pmap.members() == ["m0", "m1"]
+    e = pmap.reassign([0, 2], "s0")
+    assert e == 1 and pmap.epoch == 1
+    assert pmap.owner(0) == "s0" and pmap.owner(1) == "m1"
+    snap = pmap.snapshot()
+    assert snap["epoch"] == 1
+    assert snap["assignments"] == {"0": "s0", "1": "m1", "2": "s0", "3": "m1"}
+    with pytest.raises(KeyError):
+        pmap.reassign([9], "s0")
+    p, owner = pmap.owner_of_symbol("eth2usdt")
+    assert p == partition_of("eth2usdt", 4)
+    assert owner == pmap.owner(p)
+
+
+# ---------------------------------------------------------------------------
+# HealthGate
+
+
+def test_health_gate_debounce_and_snapback():
+    gate = HealthGate(suspect_after=2, down_after=4)
+    assert gate.state("m0") == "up"  # never polled = up
+    assert gate.record("m0", False) == "up"  # one failure is noise
+    assert gate.record("m0", False) == "suspect"
+    assert gate.record("m0", False) == "suspect"
+    assert gate.record("m0", False) == "down"
+    assert gate.is_down("m0")
+    assert gate.record("m0", True) == "up"  # any success snaps back
+    assert not gate.is_down("m0")
+    snap = gate.snapshot()
+    assert snap["m0"]["polls"] == 5
+    assert snap["m0"]["consecutive_failures"] == 0
+
+
+def test_health_gate_mark_down_skips_debounce():
+    gate = HealthGate()
+    gate.mark_down("m0")  # observed process exit: ground truth
+    assert gate.is_down("m0")
+    with pytest.raises(ValueError):
+        HealthGate(suspect_after=0)
+    with pytest.raises(ValueError):
+        HealthGate(suspect_after=5, down_after=4)
+
+
+# ---------------------------------------------------------------------------
+# PartitionRouter
+
+
+def test_router_routes_and_sheds_down_owner():
+    pmap = PartitionMap.even(2, ["m0", "m1"])
+    gate = HealthGate()
+    router = PartitionRouter(pmap, gate)
+    sym = "eth2usdt"
+    p = router.partition(sym)
+    assert router.route(sym) == pmap.owner(p)
+    gate.mark_down(pmap.owner(p))
+    with pytest.raises(RouteUnavailable) as ei:
+        router.route(sym)
+    # Retryable by construction: the degraded-path handlers key on
+    # ConnectionError, so no new plumbing is needed to shed code 14.
+    assert isinstance(ei.value, ConnectionError)
+    assert ei.value.partition == p
+    # After failover commits the reassignment, routing resumes.
+    pmap.reassign([p], "s0")
+    gate.record("s0", True)
+    assert router.route(sym) == "s0"
+    assert router.route_partition(p) == "s0"
+
+
+# ---------------------------------------------------------------------------
+# FailoverController protocol
+
+
+def _dead_fleet():
+    pmap = PartitionMap.even(2, ["m0", "m1"])
+    gate = HealthGate()
+    gate.mark_down("m0")
+    return pmap, gate
+
+
+def test_failover_claim_is_exclusive_and_gated():
+    pmap, gate = _dead_fleet()
+    fc = FailoverController(pmap, gate)
+    assert fc.claim("m1", "s0") is None  # m1 is not down
+    c = fc.claim("m0", "s0")
+    assert c is not None and c.partitions == (0,)
+    assert fc.claim("m0", "s1") is None  # already claimed
+    fc.release("m0", "s1")  # wrong standby: no-op
+    assert fc.claim("m0", "s1") is None
+    fc.release("m0", "s0")  # claimant aborts: claim re-opens
+    assert fc.claim("m0", "s1") is not None
+
+
+def test_failover_commit_voids_on_epoch_move():
+    pmap, gate = _dead_fleet()
+    fc = FailoverController(pmap, gate)
+    assert fc.claim("m0", "s0") is not None
+    pmap.reassign([0], "rebalanced")  # map moved under the claim
+    assert fc.commit("m0", "s0") is None  # stale claim is void, not applied
+    assert pmap.owner(0) == "rebalanced"
+    assert fc.history() == []
+
+
+def test_failover_full_protocol_reassigns_after_recovery():
+    pmap, gate = _dead_fleet()
+    fc = FailoverController(pmap, gate)
+    seen = []
+    epoch = fc.failover("m0", "s0", lambda dead, parts: seen.append((dead, parts)))
+    assert epoch == 1
+    assert seen == [("m0", (0,))]  # recover ran, with the claimed set
+    assert pmap.owner(0) == "s0"
+    (h,) = fc.history()
+    assert h == {"dead": "m0", "standby": "s0", "partitions": [0], "epoch": 1}
+    # Second attempt: nothing left to take over.
+    assert fc.failover("m0", "s1", lambda d, p: None) is None
+
+
+def test_failover_recovery_failure_releases_claim():
+    pmap, gate = _dead_fleet()
+    fc = FailoverController(pmap, gate)
+
+    def bad_recover(dead, parts):
+        raise RuntimeError("snapshot restore failed")
+
+    with pytest.raises(RuntimeError, match="restore failed"):
+        fc.failover("m0", "s0", bad_recover)
+    assert pmap.owner(0) == "m0"  # map untouched: crash-between-phases safe
+    assert pmap.epoch == 0
+    # The claim was released — another standby completes the handoff.
+    assert fc.failover("m0", "s1", lambda d, p: None) == 1
+    assert pmap.owner(0) == "s1"
+
+
+# ---------------------------------------------------------------------------
+# Deterministic interleaving: the failover claim race (PR 11 Interleaver)
+
+
+def _race_failover(seed: int):
+    """Two standbys race the full claim/recover/commit protocol for the
+    same dead member under one seeded schedule. Recovery replays a fake
+    WAL above the exactly-once match_seq cursor and yields mid-recovery
+    — the widest possible claim window."""
+    pmap = PartitionMap.even(2, ["m0", "m1"])
+    gate = HealthGate()
+    gate.mark_down("m0")
+    it = Interleaver(seed=seed, timeout_s=30.0)
+    fc = FailoverController(pmap, gate, lock=SteppingLock(it.step))
+    wal = [(s, f"order{s}") for s in range(1, 9)]
+    cursor = 3  # durable match_seq: replay must start at 4
+    replayed: dict[str, list[int]] = {}
+
+    def contender(name):
+        def recover(dead, parts):
+            out = replayed.setdefault(name, [])
+            for s, _ in wal:
+                it.step()  # recovery runs off-lock: the race window
+                if s <= cursor:
+                    continue  # exactly-once: below the cursor is replayed
+                out.append(s)
+
+        def fn(step):
+            step()
+            return fc.failover("m0", name, recover)
+
+        return fn
+
+    it.run(contender("s0"), contender("s1"))
+    assert it.errors == [None, None]
+    return it, pmap, fc, replayed
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_interleaved_failover_exactly_one_winner(seed):
+    it, pmap, fc, replayed = _race_failover(seed)
+    winners = [r for r in it.results if r is not None]
+    assert len(winners) == 1, f"expected one epoch winner, got {it.results}"
+    assert winners[0] == 1  # single reassignment: epoch 0 -> 1
+    (h,) = fc.history()
+    # Exactly one member consumed the reassigned partition: the loser's
+    # claim failed BEFORE recovery, so it never touched the WAL.
+    assert list(replayed) == [h["standby"]]
+    assert replayed[h["standby"]] == [4, 5, 6, 7, 8]
+    assert pmap.owner(0) == h["standby"]
+    assert pmap.owner(1) == "m1"  # unrelated partition never moves
+
+
+def test_interleaved_failover_replay_identical_across_schedules():
+    replays = set()
+    for seed in range(12):
+        _, _, fc, replayed = _race_failover(seed)
+        (h,) = fc.history()
+        replays.add(tuple(replayed[h["standby"]]))
+    # Whoever wins under whatever schedule, the replayed match_seqs are
+    # the same — the cursor, not the schedule, decides what re-emits.
+    assert replays == {(4, 5, 6, 7, 8)}
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController
+
+
+def _admission(depth, **kw):
+    kw.setdefault("cache_s", 0.0)  # sample depth_fn on every admit
+    kw.setdefault("registry", Registry())
+    return AdmissionController(depth, **kw)
+
+
+def test_admission_admits_below_ceiling():
+    a = _admission(lambda: 10, max_depth=100)
+    d = a.admit(5)
+    assert d.ok and d.depth == 10
+    assert a.admit(90).ok  # 10 + 90 == ceiling: still admitted
+
+
+def test_admission_sheds_on_depth_with_scaled_hint():
+    a = _admission(
+        lambda: 200, max_depth=100, retry_after_s=0.05, retry_after_max_s=2.0
+    )
+    d = a.admit(1)
+    assert not d.ok and d.reason == "depth" and d.depth == 200
+    # Hint scales with overshoot: (200+1)/100 ~ 2x ceiling -> ~2x base.
+    assert d.retry_after_s == pytest.approx(0.05 * 201 / 100)
+    m = RETRY_AFTER_RE.search(d.message())
+    assert m is not None  # clients parse the hint out of the message
+    assert float(m.group(1)) == pytest.approx(d.retry_after_s, abs=1e-3)
+    assert "queue depth 200" in d.message()
+
+
+def test_admission_hint_clamps_to_max():
+    a = _admission(
+        lambda: 10_000_000, max_depth=100, retry_after_s=0.05,
+        retry_after_max_s=2.0,
+    )
+    assert a.admit(1).retry_after_s == 2.0
+    # And never below the base, however shallow the queue reads.
+    b = _admission(lambda: 0, max_depth=100, retry_after_s=0.05)
+    assert b._hint(0) == 0.05
+
+
+def test_admission_sheds_on_tight_deadline_first():
+    # Deadline shed fires even with an empty queue — the reply would be
+    # DEADLINE_EXCEEDED garbage, so zero pipeline work is spent on it.
+    a = _admission(lambda: 0, max_depth=100, min_deadline_s=0.5)
+    d = a.admit(1, time_remaining_s=0.1)
+    assert not d.ok and d.reason == "deadline"
+    assert "deadline too tight" in d.message()
+    assert a.admit(1, time_remaining_s=0.5).ok  # at the bound: admitted
+    assert a.admit(1, time_remaining_s=None).ok  # no deadline set
+
+
+def test_admission_counters_and_validation():
+    reg = Registry()
+    calls = []
+
+    def depth():
+        calls.append(1)
+        return 101
+
+    a = AdmissionController(
+        depth, max_depth=100, cache_s=0.0, registry=reg
+    )
+    a.admit(3)
+    a.admit(2, time_remaining_s=-1.0)  # min_deadline_s=0.0 > -1.0
+    text = reg.render()
+    assert 'gome_gateway_shed_total{reason="depth"} 3' in text
+    assert 'gome_gateway_shed_total{reason="deadline"} 2' in text
+    assert "gome_gateway_admission_depth 101" in text
+    with pytest.raises(ValueError):
+        _admission(lambda: 0, max_depth=0)
+    with pytest.raises(ValueError):
+        _admission(lambda: 0, retry_after_s=0.5, retry_after_max_s=0.1)
+
+
+def test_admission_depth_cache_window():
+    calls = []
+
+    def depth():
+        calls.append(1)
+        return 0
+
+    a = AdmissionController(
+        depth, max_depth=100, cache_s=60.0, registry=Registry()
+    )
+    for _ in range(5):
+        assert a.admit(1).ok
+    assert len(calls) == 1  # hot path: one sample per cache window
+
+
+# ---------------------------------------------------------------------------
+# FrameBatcher adaptive sizing
+
+
+class _Sink:
+    def __init__(self):
+        self.frames: list[bytes] = []
+
+    def publish(self, data, headers=None):
+        self.frames.append(data)
+        return len(self.frames)
+
+
+def _order(i):
+    return Order(
+        uuid="u", oid=f"o{i}", symbol="btc2usdt", side=Side.BUY,
+        price=100 + i, volume=5, action=Action.ADD,
+    )
+
+
+def _adaptive(depth_fn, **kw):
+    kw.setdefault("max_n", 100)
+    kw.setdefault("min_n", 10)
+    kw.setdefault("depth_low", 100)
+    kw.setdefault("depth_high", 1100)
+    kw.setdefault("resize_interval_s", 0.0)  # resample every call
+    kw.setdefault("max_wait_s", 60.0)
+    return FrameBatcher(_Sink(), depth_fn=depth_fn, **kw)
+
+
+def test_adaptive_bound_interpolates_and_clamps():
+    depth = [0]
+    b = _adaptive(lambda: depth[0])
+    try:
+        assert b.effective_max_n() == 10  # shallow: latency mode
+        depth[0] = 100
+        assert b.effective_max_n() == 10  # at depth_low: still min_n
+        depth[0] = 600  # midpoint of the band
+        assert b.effective_max_n() == 55
+        depth[0] = 1100
+        assert b.effective_max_n() == 100  # at depth_high: throughput mode
+        depth[0] = 10**9
+        assert b.effective_max_n() == 100  # clamped above the band
+        depth[0] = -50
+        assert b.effective_max_n() == 10  # clamped below it
+        st = b.stats()
+        assert st["adaptive"] is True and st["effective_max_n"] == 10
+    finally:
+        b.close()
+
+
+def test_adaptive_depth_fn_failure_falls_back_to_max_n():
+    def boom():
+        raise RuntimeError("bus gone")
+
+    b = _adaptive(boom)
+    try:
+        # Throughput-safe fallback: an unreadable lag reads as "deep",
+        # so the batcher amortizes instead of shrinking frames blind.
+        assert b.effective_max_n() == 100
+    finally:
+        b.close()
+
+
+def test_adaptive_flushes_at_effective_bound():
+    depth = [0]
+    b = _adaptive(lambda: depth[0], max_n=8, min_n=2, depth_low=10,
+                  depth_high=20)
+    try:
+        for i in range(4):
+            b.submit(_order(i))
+        # Shallow queue -> effective bound 2 -> two frames of two.
+        assert len(b.queue.frames) == 2
+        depth[0] = 1000  # deep: bound grows to max_n=8
+        for i in range(4, 10):
+            b.submit(_order(i))
+        assert len(b.queue.frames) == 2  # six buffered, bound now 8
+        b.submit(_order(10))
+        b.submit(_order(11))
+        assert len(b.queue.frames) == 3  # flushed at 8
+    finally:
+        b.close()
+
+
+def test_adaptive_validation_and_fixed_mode():
+    with pytest.raises(ValueError, match="1 <= min_n <= max_n"):
+        _adaptive(lambda: 0, min_n=0)
+    with pytest.raises(ValueError, match="1 <= min_n <= max_n"):
+        _adaptive(lambda: 0, min_n=101, max_n=100)
+    with pytest.raises(ValueError, match="depth_low < depth_high"):
+        _adaptive(lambda: 0, depth_low=5, depth_high=5)
+    # min_n without depth_fn (or vice versa) = the fixed bound of <= r11.
+    b = FrameBatcher(_Sink(), max_n=7, min_n=3, max_wait_s=60.0)
+    try:
+        assert b.effective_max_n() == 7
+        assert b.stats()["adaptive"] is False
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet aggregator liveness (last-poll age / stale / member_up)
+
+
+def _scripted_fetch(down: set):
+    def fetch(url, timeout_s):
+        proc, _, path = url.partition("://")[2].partition("/")
+        if proc in down:
+            raise ConnectionError("connection refused")
+        path = "/" + path
+        if path == "/healthz":
+            return json.dumps({"healthy": True, "detail": {}})
+        if path == "/metrics":
+            return "# empty\n"
+        if path == "/durability":
+            return json.dumps({"matchfeed": {
+                "last_seq": 1, "observed": 2, "dupes": 0, "gaps": 0,
+            }})
+        if path.startswith("/timeline"):
+            return json.dumps({"samples": []})
+        raise AssertionError(url)
+
+    return fetch
+
+
+def test_aggregator_staleness_and_member_up():
+    now = [100.0]
+    down: set = set()
+    reg = Registry()
+    agg = FleetAggregator()
+    agg.install(
+        {"a": "inproc://a", "b": "inproc://b"},
+        interval_s=1.0, stale_after_s=5.0, clock=lambda: now[0],
+        fetch=_scripted_fetch(down), registry=reg,
+    )
+    try:
+        assert agg.poll_age_s("a") is None  # never scraped yet
+        assert not agg.member_up("a")
+        agg.poll()
+        assert agg.poll_age_s("a") == 0.0
+        assert agg.member_up("a") and agg.member_up("b")
+        assert 'gome_fleet_member_up{proc="a"} 1' in reg.render()
+        payload = agg.payload()
+        assert payload["unreachable"] == []
+        assert payload["stale_after_s"] == 5.0
+        assert payload["members"]["a"]["up"] is True
+        assert payload["members"]["a"]["stale"] is False
+
+        # b stops answering: its poll age keeps growing while a's resets.
+        down.add("b")
+        now[0] += 3.0
+        agg.poll()
+        assert agg.member_up("a")
+        assert not agg.member_up("b")  # latest scrape errored
+        payload = agg.payload()
+        assert payload["unreachable"] == ["b"]
+        assert payload["members"]["b"]["error"] is not None
+        assert payload["members"]["b"]["poll_age_s"] == 3.0
+
+        # Past stale_after_s without a successful scrape: STALE, down.
+        now[0] += 3.0
+        agg.poll()
+        assert agg.poll_age_s("b") == 6.0
+        payload = agg.payload()
+        assert payload["members"]["b"]["stale"] is True
+        text = reg.render()
+        assert 'gome_fleet_member_up{proc="a"} 1' in text
+        assert 'gome_fleet_member_up{proc="b"} 0' in text
+
+        # Recovery: one good scrape snaps b back up.
+        down.discard("b")
+        now[0] += 1.0
+        agg.poll()
+        assert agg.member_up("b")
+        assert agg.payload()["unreachable"] == []
+    finally:
+        agg.disable()
+
+
+def test_aggregator_stale_after_validation_and_default():
+    agg = FleetAggregator()
+    with pytest.raises(ValueError, match="stale_after_s"):
+        agg.install({"a": "inproc://a"}, stale_after_s=0.0)
+    agg.install(
+        {"a": "inproc://a"}, interval_s=2.0, registry=Registry(),
+        fetch=_scripted_fetch(set()),
+    )
+    try:
+        assert agg.stale_after_s == 6.0  # default: 3x the poll interval
+    finally:
+        agg.disable()
+
+
+# ---------------------------------------------------------------------------
+# Client retry of the retryable status (code 14)
+
+
+def _resp(code=0, accepted=0, reject_index=(), message=""):
+    return SimpleNamespace(
+        code=code, accepted=accepted, reject_index=list(reject_index),
+        message=message,
+    )
+
+
+def test_send_batch_retrying_resubmits_only_the_tail():
+    orders = [f"o{i}" for i in range(6)]
+    cancels = [f"c{i}" for i in range(6)]
+    seen = []
+    sleeps = []
+    script = [
+        _resp(code=CODE_RETRYABLE, accepted=2, reject_index=[2],
+              message="overloaded, queue depth 9 (retry-after=0.123s)"),
+        _resp(code=0, accepted=3),
+    ]
+
+    def send(orders, cancel):
+        seen.append((list(orders), list(cancel)))
+        return script.pop(0)
+
+    out = send_batch_retrying(
+        send, orders, cancels, policy=BackoffPolicy(base_s=0.001, max_s=0.001),
+        rng=random.Random(0), sleep=sleeps.append,
+    )
+    assert out == {"ok": 5, "rejected": 1, "aborted": 0, "retries": 1}
+    # Remainder contract: consumed prefix = accepted + len(reject_index),
+    # so the retry resubmitted exactly the unconsumed tail — both lists.
+    assert seen[1] == (["o3", "o4", "o5"], ["c3", "c4", "c5"])
+    assert len(sleeps) == 1
+    assert sleeps[0] >= 0.123  # server hint is a floor under the jitter
+
+
+def test_send_batch_retrying_budget_exhaustion_aborts_tail():
+    def send(orders, cancel):
+        return _resp(code=CODE_RETRYABLE, accepted=1,
+                     message="overloaded, queue depth 9 (retry-after=0.001s)")
+
+    out = send_batch_retrying(
+        send, [f"o{i}" for i in range(10)], None,
+        policy=BackoffPolicy(base_s=0.0001, max_s=0.0001, max_retries=2),
+        rng=random.Random(0), sleep=lambda s: None,
+    )
+    # 3 sends (initial + 2 retries), 1 accepted each; the rest aborts
+    # loudly instead of hammering a drowning gateway forever.
+    assert out["ok"] == 3 and out["retries"] == 2 and out["aborted"] == 7
+
+
+def test_send_batch_retrying_permanent_abort_not_resubmitted():
+    sends = []
+
+    def send(orders, cancel):
+        sends.append(len(orders))
+        return _resp(code=3, accepted=2, message="batch aborted at entry 2")
+
+    out = send_batch_retrying(send, [f"o{i}" for i in range(5)], None,
+                              sleep=lambda s: None)
+    assert sends == [5]  # permanent code: never resubmitted
+    assert out == {"ok": 2, "rejected": 0, "aborted": 3, "retries": 0}
+
+
+# ---------------------------------------------------------------------------
+# The committed fleet chaos verdict
+
+
+def test_fleet_chaos_verdict_pinned_green():
+    """FLEET_CHAOS_r01.json is the committed proof that the 2x2 fleet
+    survives rotating member kills: injected deaths only, exactly-once
+    across the fleet, bit-exact books vs the uninterrupted oracle,
+    bounded recovery, and a throughput floor while a member is down.
+    Regenerate with scripts/fleet_chaos.py; a red verdict must never be
+    committed."""
+    path = REPO / "FLEET_CHAOS_r01.json"
+    doc = json.loads(path.read_text())
+    assert doc["schema"] == "gome-fleet-chaos-verdict-v1"
+    assert doc["pass"] is True
+    assert all(doc["checks"].values()), {
+        k: v for k, v in doc["checks"].items() if not v
+    }
+
+    # >= 3 kill/restart cycles covering all three fault classes.
+    cycles = doc["cycles"]
+    classes = {c["class"] for c in cycles}
+    assert classes == {"consumer-kill", "gateway-kill", "bus-disconnect"}
+    kills = [c for c in cycles if c["class"] != "bus-disconnect"]
+    assert len(kills) >= 2 and len(cycles) >= 3
+
+    # Every partition: zero dupes/gaps at first_seq=0, books bit-exact
+    # against the oracle, and the full match stream byte-identical.
+    for part in doc["partitions"]:
+        audit = part["seq_audit"]
+        assert audit["dupes"] == 0 and audit["gaps"] == 0
+        assert audit["observed"] == audit["last_seq"] + 1
+        assert part["digest_match"] is True
+        assert part["book_digest"] == part["oracle_digest"]
+        assert part["match_stream_identical"] is True
+        assert part["feed"]["dupes"] == 0 and part["feed"]["gaps"] == 0
+
+    # Deaths were ours alone, and every consumer kill failed over
+    # through the claim/recover/commit protocol (epoch advanced).
+    assert doc["checks"]["injected_deaths_only"]
+    consumer_kills = [c for c in cycles if c["class"] == "consumer-kill"]
+    for c in consumer_kills:
+        assert c["failover"]["epoch"] is not None
+    assert len(doc["router"]["failovers"]) == len(consumer_kills)
+
+    # Recovery bounded, degraded throughput above the floor.
+    rec = doc["recovery"]
+    assert len(rec["samples_s"]) == len(kills)
+    assert rec["p99_s"] <= doc["config"]["recovery_bound_s"]
+    floor = doc["throughput"]["floor_orders_per_s"]
+    assert len(doc["throughput"]["degraded_windows"]) == len(kills)
+    for w in doc["throughput"]["degraded_windows"].values():
+        assert w["orders_per_s"] >= floor
