@@ -52,12 +52,22 @@ cooperating pieces:
     contexts). ``FLEET`` follows the same disabled-singleton hot-path
     contract; ``scripts/fleet_drill.py`` publishes ``FLEET_r01.json``
     from a real 2-gateway x 2-consumer subprocess fleet.
+  * ``capacity`` — the LOAD axis (ISSUE 17): coordinated-omission-safe
+    latency recording (:class:`~capacity.LogHistogram`, mergeable /
+    byte-stable across processes), the open-loop intended-arrival
+    schedule, saturation-knee detection, and bottleneck attribution;
+    ``CAPACITY`` serves the committed sweep verdict
+    (``CAPACITY_r01.json``) as the ops ``/capacity`` payload +
+    ``gome_capacity_*`` gauges. ``scripts/capacity.py`` drives the
+    offered-rate ladder against the single-process service and the
+    real 2x2 fleet.
   * ``scripts/perf_ratchet.py`` — gates the deterministic analytic
     metrics (flops/order, bytes/order, peak HBM, compile count) against
     the committed ``PERF_BASELINE.json`` in CI.
 
 Import discipline: this ``__init__`` pulls in only ``compile_journal``,
-``timeline``, and ``hostprof`` (all dependency-free) so ``engine.frames``
+``timeline``, ``hostprof``, and ``capacity`` (all dependency-free) so
+``engine.frames``
 / ``service.gateway`` can import the JOURNAL/TIMELINE/HOSTPROF
 singletons without a cycle; ``costmodel`` (which imports the engine),
 ``live``, and ``profiler`` load lazily on first attribute access
@@ -67,6 +77,7 @@ and the engine out of its import path on purpose).
 
 from __future__ import annotations
 
+from .capacity import CAPACITY, LogHistogram, OpenLoopSchedule
 from .compile_journal import JOURNAL, CompileJournal, frame_combo_detail
 from .hostprof import HOSTPROF, HostSampler
 from .timeline import TIMELINE, TimelineSampler, service_timeline
@@ -80,6 +91,10 @@ __all__ = [
     "service_timeline",
     "HOSTPROF",
     "HostSampler",
+    "CAPACITY",
+    "LogHistogram",
+    "OpenLoopSchedule",
+    "capacity",
     "hostprof",
     "costmodel",
     "fleet",

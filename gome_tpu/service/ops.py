@@ -26,6 +26,10 @@ one stdlib ThreadingHTTPServer, no dependencies, curl-able:
     curl localhost:9109/fleet       # merged N-process view (obs.fleet):
                                     # per-member health, summed counters,
                                     # fleet-wide seq audit
+    curl localhost:9109/capacity    # installed capacity-sweep verdict
+                                    # (obs.capacity): offered-rate ladder,
+                                    # knee, corrected percentiles,
+                                    # bottleneck attribution
 
 Enabled by an `ops:` section in config.yaml (port, host) or by
 constructing OpsServer directly around any EngineService.
@@ -190,6 +194,16 @@ class OpsServer:
 
         return FLEET.payload()
 
+    def capacity_payload(self) -> dict:
+        """The /capacity JSON document: the installed load-sweep verdict
+        (gome_tpu.obs.capacity.CAPACITY) — the offered-rate ladder with
+        corrected (coordinated-omission-safe) percentiles, the detected
+        saturation knee, and the per-stage bottleneck attribution table.
+        ``{"enabled": false}`` while no verdict is installed."""
+        from ..obs.capacity import CAPACITY
+
+        return CAPACITY.payload()
+
     def hostprof_payload(self, run_drill: bool = False) -> dict:
         """The /hostprof JSON document: the host-CPU sampling profiler
         (gome_tpu.obs.hostprof.HOSTPROF) — the live wall-profile stage
@@ -285,6 +299,11 @@ class OpsServer:
                             ops.fleet_payload(), default=str
                         ).encode()
                         self._send(200, body, "application/json")
+                    elif self.path.split("?")[0] == "/capacity":
+                        body = json.dumps(
+                            ops.capacity_payload(), default=str
+                        ).encode()
+                        self._send(200, body, "application/json")
                     elif self.path.split("?")[0] == "/trace":
                         query = (self.path.split("?", 1)[1:] or [""])[0]
                         rec = ops.tracer.recorder
@@ -323,7 +342,7 @@ class OpsServer:
         self._thread.start()
         log.info("ops endpoint up on %s:%d (/metrics, /healthz, /trace, "
                  "/cost, /timeline, /profile, /hostprof, /durability, "
-                 "/fleet)",
+                 "/fleet, /capacity)",
                  self.host, self.port)
         return self
 

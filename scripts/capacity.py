@@ -1,0 +1,1234 @@
+#!/usr/bin/env python
+"""Capacity observatory sweep (ISSUE 17): drive the matching engine to
+its knee with an OPEN-LOOP offered-rate ladder and say where the time
+goes.
+
+Every prior latency artifact in this repo was measured closed-loop — the
+driver waited for the service before sending the next order, so under
+saturation the arrival process silently slowed down and queueing delay
+never reached the percentiles (coordinated omission). This sweep fixes
+the arrival model: each order has an *intended* send time from a fixed
+:class:`gome_tpu.obs.capacity.OpenLoopSchedule` at the offered rate, the
+driver sends on that clock (immediately when behind — the backlog is
+charged to latency, never forgiven), and every per-order latency is
+``completion - intended`` recorded into a mergeable
+:class:`~gome_tpu.obs.capacity.LogHistogram`.
+
+Two targets, one verdict schema (``gome-capacity-verdict-v1``):
+
+  * default — the single-process service stack (gateway step -> memory
+    bus -> consumer -> engine, the soak/bench pipeline) with exact
+    per-frame completion times. Fast enough for CI: the ninth tier-1
+    gate runs this as a ~10 s smoke ladder.
+  * ``--fleet`` — the real 2-gateway x 2-consumer subprocess fleet from
+    scripts/fleet_drill.py (same workers, same file bus + RESP marker
+    store), driven per-partition over columnar ``DoOrderBatch`` streams
+    routed by ``fleet.partition_of``. Completion times come from polling
+    each consumer's ``gome_orders_consumed_total`` (per-partition FIFO
+    inverts the counter into per-order completions, interpolated between
+    samples). The committed CAPACITY_r01.json is produced by this mode.
+
+Each ladder point records offered vs delivered rate, corrected AND
+legacy closed-loop percentiles, an exactly-once audit (match-queue seq
+dupes/gaps + conservation), and a bottleneck-attribution table joining
+the driver's own measurements (send backlog, batch accumulation, admit
+RPC wall) with the fleet's telemetry (``gome_stage_seconds`` deltas,
+``gome_bus_depth`` Little's-law wait, timeline RSS/nivcsw). The knee is
+the first point where delivered/offered < 0.98 or the corrected p99
+blows its budget; the verdict names the saturated stage there.
+
+Usage:
+    python scripts/capacity.py --seconds 10 --out capacity_smoke.json
+    python scripts/capacity.py --fleet --window 4 --out CAPACITY_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Must be set before anything imports jax (fleet workers inherit it).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from gome_tpu.obs.capacity import (  # noqa: E402 - after the platform pin
+    SCHEMA,
+    LogHistogram,
+    OpenLoopSchedule,
+    attribution_check,
+    find_knee,
+    monotone_ladder,
+    saturated_stage,
+)
+
+#: Histogram geometry shared by every recorder in one sweep (merge
+#: requires identical params; 1% relative error, 1 us .. 10 min).
+HIST_KW = dict(rel_err=0.01, min_value=1e-6, max_value=600.0)
+
+
+class _CrossingFlow:
+    """Bounded-book sweep flow (single mode): round-robin symbols,
+    alternating buy/sell limit pairs at ONE price so every pair trades
+    and resting depth stays ~1 per symbol. A capacity sweep must hold
+    frame geometry stationary — ``bench._MixedFlow``'s depth walk
+    ratchets the packed-book shape mid-ladder and every ratchet is a
+    trace+compile stall that would masquerade as a knee."""
+
+    def __init__(self, n_symbols: int):
+        import numpy as np
+
+        self.np = np
+        self.n_symbols = n_symbols
+        self.i0 = 0
+
+    def frame(self, n: int) -> dict:
+        np = self.np
+        i = self.i0 + np.arange(n, dtype=np.int64)
+        self.i0 += n
+        sym = (i % self.n_symbols).astype(np.uint32)
+        return dict(
+            n=n,
+            action=np.ones(n, np.uint8),
+            side=((i // self.n_symbols) % 2).astype(np.uint8),
+            kind=np.zeros(n, np.uint8),
+            price=np.full(n, 100_000_000, np.int64),
+            volume=np.ones(n, np.int64),
+            symbol_idx=sym,
+            uuid_idx=(i % 256).astype(np.uint32),
+            oids=np.char.add("o", i.astype("U12")).astype("S"),
+        )
+
+
+def steady_delivered(done_t: list, window_end: float, batch_n: int,
+                     t0: float) -> float:
+    """Delivered rate in steady state: completions per second between
+    the FIRST and LAST in-window completion. Counting from t0 (or to
+    window_end) would charge the pipeline-fill ramp and the in-flight
+    tail against throughput — at a short window that undercount alone
+    fakes a knee."""
+    in_win = [d for d in done_t if d <= window_end]
+    if len(in_win) >= 3 and in_win[-1] > in_win[0]:
+        return (len(in_win) - 1) * batch_n / (in_win[-1] - in_win[0])
+    elapsed = max(window_end - t0, 1e-9)
+    return len(in_win) * batch_n / elapsed
+
+
+#: Tracer stages that measure WAITING (overlapping across in-flight
+#: orders), not a resource being busy — their span-sum over wall time is
+#: not an occupancy, so they never compete for "saturated stage".
+_WAIT_STAGES = frozenset({"ingress", "enqueue", "batch_wait", "bus_transit"})
+
+
+def _hist() -> LogHistogram:
+    return LogHistogram(**HIST_KW)
+
+
+def write_json(path: str, doc: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def geometric_ladder(lo: float, hi: float, k: int) -> list[float]:
+    """k strictly increasing rates from lo to hi, geometric spacing."""
+    if k < 2:
+        return [hi]
+    f = (hi / lo) ** (1.0 / (k - 1))
+    return [lo * f**i for i in range(k)]
+
+
+def _lat_summary(h: LogHistogram) -> dict:
+    return h.summary(qs=(0.5, 0.9, 0.99, 0.999))
+
+
+# -- verdict assembly (shared by both modes) ---------------------------------
+
+
+def build_verdict(mode: str, config: dict, points: list[dict],
+                  delivered_floor: float, p99_budget_s: float,
+                  extra_checks: dict | None = None) -> dict:
+    knee_idx, knee_reason = find_knee(
+        points, delivered_floor=delivered_floor, p99_budget_s=p99_budget_s
+    )
+    knee: dict = {"found": knee_idx is not None}
+    if knee_idx is not None:
+        kp = points[knee_idx]
+        knee.update({
+            "index": knee_idx,
+            "reason": knee_reason,
+            "offered_per_sec": kp["offered_per_sec"],
+            "delivered_per_sec": kp["delivered_per_sec"],
+            "corrected_p99_s": kp["corrected"]["p99_s"],
+            "saturated_stage": saturated_stage(
+                kp["attribution"]["rows"]
+            ),
+            "attribution_frac_err": kp["attribution"]["frac_err"],
+        })
+    checks = {
+        "monotone_ladder": monotone_ladder(points),
+        "ladder_has_5_points": len(points) >= 5,
+        "knee_found": knee_idx is not None,
+        "exactly_once_all_points": all(
+            p["exactly_once"]["dupes"] == 0
+            and p["exactly_once"]["gaps"] == 0
+            and p["exactly_once"]["drained"]
+            for p in points
+        ),
+        "corrected_recorded_all_points": all(
+            p["corrected"]["count"] == p["sent"] for p in points
+        ),
+        "attribution_rows_nonempty": all(
+            p["attribution"]["rows"] for p in points
+        ),
+        "attribution_within_tol_at_knee": (
+            knee_idx is not None
+            and points[knee_idx]["attribution"]["within_tol"]
+        ),
+    }
+    checks.update(extra_checks or {})
+    return {
+        "schema": SCHEMA,
+        "mode": mode,
+        "config": config,
+        "ladder": points,
+        "knee": knee,
+        "checks": checks,
+        "pass": all(checks.values()),
+    }
+
+
+def print_verdict(verdict: dict, out: str) -> None:
+    status = "PASS" if verdict["pass"] else "FAIL"
+    print(f"capacity: {status} -> {out}")
+    for p in verdict["ladder"]:
+        print(
+            f"  offered {p['offered_per_sec']:8.1f}/s  delivered "
+            f"{p['delivered_per_sec']:8.1f}/s ({p['delivered_frac']:.3f})  "
+            f"corrected p50 {p['corrected']['p50_s'] * 1e3:7.1f}ms  "
+            f"p99 {p['corrected']['p99_s'] * 1e3:8.1f}ms"
+        )
+    knee = verdict["knee"]
+    if knee.get("found"):
+        print(
+            f"  knee @ {knee['offered_per_sec']:.1f}/s offered "
+            f"({knee['reason']}); saturated stage: "
+            f"{knee['saturated_stage']} "
+            f"(attribution err {knee['attribution_frac_err']:.3f})"
+        )
+    for name, ok in verdict["checks"].items():
+        print(f"  [{'ok' if ok else 'BREACH'}] {name}")
+
+
+# ===========================================================================
+# single-process mode (smoke ladder: CI's ninth gate, obs_snapshot capture)
+# ===========================================================================
+
+
+def _counter(name: str) -> int:
+    from gome_tpu.utils.metrics import REGISTRY
+
+    return int(REGISTRY.counter(name).value())
+
+
+def _stage_snapshot() -> dict:
+    """{stage: (count, sum_s)} from the armed tracer's histograms."""
+    from gome_tpu.utils.trace import TRACER
+
+    return {
+        s: (v["count"], v["sum"]) for s, v in TRACER.stage_summary().items()
+    }
+
+
+def run_single_point(engine, bus, consumer, flow, symbols,
+                     rate: float, window_s: float, batch_n: int) -> dict:
+    """One open-loop load point against the in-process pipeline.
+
+    The consumer is co-operative (no thread of its own): while the
+    driver is ahead of schedule it drains completions; when it falls
+    behind it publishes immediately and the backlog lands in the
+    corrected latency, exactly as the open-loop contract demands."""
+    from bench import _svc_gateway_step
+
+    n_frames = max(2, int(rate * window_s) // batch_n)
+    frames = [flow.frame(batch_n) for _ in range(n_frames)]
+    n_point = n_frames * batch_n
+
+    stage0 = _stage_snapshot()
+    fail0 = _counter("gome_consumer_step_failures_total")
+    ev_off = bus.match_queue.end_offset()
+
+    corrected, closed = _hist(), _hist()
+    sched = OpenLoopSchedule(rate, t0=time.perf_counter())
+    pub_t: list[float] = []
+    done_t: list[float] = []
+    backlog: list[float] = []
+    gw_wall = 0.0
+
+    def drain_step() -> int:
+        n = consumer.run_once()
+        if n:
+            now = time.perf_counter()
+            for _ in range(n // batch_n):
+                done_t.append(now)
+        return n
+
+    for fi, cols in enumerate(frames):
+        due = sched.batch_due(fi * batch_n, batch_n)
+        while True:
+            now = time.perf_counter()
+            if now >= due:
+                break
+            if not drain_step():
+                time.sleep(min(0.0005, due - now))
+        actual = time.perf_counter()
+        backlog.append(actual - due)
+        pub_t.append(actual)
+        t_gw = time.perf_counter()
+        _svc_gateway_step(cols, symbols, engine.pre_pool, bus.order_queue)
+        gw_wall += time.perf_counter() - t_gw
+        drain_step()
+    window_end = time.perf_counter()
+
+    deadline = time.monotonic() + 120.0
+    while len(done_t) < n_frames and time.monotonic() < deadline:
+        if not drain_step():
+            n = consumer.drain()
+            now = time.perf_counter()
+            for _ in range(n // batch_n):
+                done_t.append(now)
+            if len(done_t) < n_frames:
+                time.sleep(0.0005)
+    drained = len(done_t) >= n_frames
+
+    # Per-order latency: FIFO frames; order j of frame f was intended at
+    # sched.intended(f*batch_n + j) and completed at done_t[f].
+    for f in range(min(len(done_t), n_frames)):
+        d = done_t[f]
+        for j in range(batch_n):
+            corrected.record(max(d - sched.intended(f * batch_n + j), 0.0))
+        closed.record(max(d - pub_t[f], 0.0), count=batch_n)
+
+    elapsed_send = window_end - sched.t0
+    delivered_per_sec = steady_delivered(
+        done_t, window_end, batch_n, sched.t0
+    )
+    busy_s = max(done_t[-1] if done_t else window_end, window_end) - sched.t0
+
+    # -- exactly-once: conservation + (if stamped) the seq audit ---------
+    from gome_tpu.bus.colwire import decode_event_frame
+
+    events, seqs = 0, []
+    for m in bus.match_queue.read_from(ev_off, 1 << 20):
+        for r in decode_event_frame(m.body).to_results():
+            events += 1
+            if r.seq is not None:
+                seqs.append(r.seq)
+    bus.match_queue.commit(bus.match_queue.end_offset())
+    bus.match_queue.compact()
+    bus.order_queue.compact()
+    step_failures = _counter("gome_consumer_step_failures_total") - fail0
+    consumed = len(done_t) * batch_n
+    exactly_once = {
+        "method": "conservation+seq",
+        "sent": n_point,
+        "consumed": consumed,
+        "events": events,
+        "dupes": 0,
+        "gaps": (n_point - consumed) + step_failures,
+        "drained": drained and step_failures == 0,
+    }
+
+    # -- attribution -----------------------------------------------------
+    stage1 = _stage_snapshot()
+    mean_backlog = sum(backlog) / len(backlog) if backlog else 0.0
+    in_pipeline = [
+        done_t[f] - pub_t[f] for f in range(min(len(done_t), n_frames))
+    ]
+    in_pipeline_mean = (
+        sum(in_pipeline) / len(in_pipeline) if in_pipeline else 0.0
+    )
+    rows = [
+        {
+            "stage": "arrival_accumulation",
+            "seconds_per_order": sched.accumulation_mean(batch_n),
+            "utilization": None,
+            "source": "analytic (batch_n-1)/(2*rate)",
+        },
+        {
+            "stage": "send_backlog",
+            "seconds_per_order": mean_backlog,
+            "utilization": None,
+            "source": "driver (actual publish - intended last-of-frame)",
+        },
+        {
+            "stage": "gateway_step",
+            "seconds_per_order": gw_wall / max(n_frames, 1),
+            "utilization": gw_wall / busy_s if busy_s > 0 else 0.0,
+            "source": "driver (publish call wall per frame)",
+        },
+    ]
+    stage_total = 0.0
+    for stage in sorted(set(stage0) | set(stage1)):
+        c0, s0 = stage0.get(stage, (0, 0.0))
+        c1, s1 = stage1.get(stage, (0, 0.0))
+        dc, ds = c1 - c0, s1 - s0
+        if dc <= 0:
+            continue
+        per_order = ds / dc  # an order rides its whole frame's span
+        stage_total += per_order
+        busy_like = stage not in _WAIT_STAGES
+        rows.append({
+            "stage": stage,
+            "seconds_per_order": per_order,
+            "utilization": (
+                ds / busy_s if busy_s > 0 else 0.0
+            ) if busy_like else None,
+            "source": "tracer gome_stage_seconds delta / spans",
+        })
+    rows.append({
+        "stage": "bus_wait",
+        "seconds_per_order": max(
+            in_pipeline_mean - gw_wall / max(n_frames, 1) - stage_total, 0.0
+        ),
+        "utilization": None,
+        "source": "residual (in-pipeline mean minus processing stages)",
+    })
+    attr = attribution_check(rows, corrected.mean(), tol=0.05)
+    attr["rows"] = rows
+
+    return {
+        "offered_per_sec": rate,
+        "delivered_per_sec": round(delivered_per_sec, 2),
+        "delivered_frac": round(
+            delivered_per_sec / rate if rate > 0 else 0.0, 4
+        ),
+        "sent": n_point,
+        "frames": n_frames,
+        "batch_n": batch_n,
+        "window_s": round(elapsed_send, 3),
+        "send_backlog_s_mean": round(mean_backlog, 6),
+        "corrected": _lat_summary(corrected),
+        "closed_loop": _lat_summary(closed),
+        "exactly_once": exactly_once,
+        "attribution": attr,
+    }
+
+
+def run_single_sweep(seconds: float = 10.0, points: int = 6,
+                     symbols: int = 32, cap: int = 128, batch_n: int = 256,
+                     pipeline: int = 2, seed: int = 17,
+                     delivered_floor: float = 0.98,
+                     p99_budget_s: float = 1.0,
+                     rates: list[float] | None = None) -> dict:
+    """The smoke ladder: calibrate, sweep, verdict. Importable (the
+    obs_snapshot capture and the CI gate call this in-process)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _svc_warmup
+    from gome_tpu.bus import MemoryQueue, QueueBus
+    from gome_tpu.engine import BookConfig
+    from gome_tpu.engine.orchestrator import MatchEngine
+    from gome_tpu.service.consumer import OrderConsumer
+    from gome_tpu.utils.metrics import Registry
+    from gome_tpu.utils.trace import TRACER, FlightRecorder
+
+    kernel = "pallas" if jax.default_backend() == "tpu" else "scan"
+    engine = MatchEngine(
+        config=BookConfig(cap=cap, max_fills=16, dtype=jnp.int32),
+        n_slots=symbols, max_t=32, kernel=kernel,
+    )
+    bus = QueueBus(MemoryQueue("doOrder"), MemoryQueue("matchOrder"))
+    consumer = OrderConsumer(
+        engine, bus, batch_n=1, batch_wait_s=0, match_wire="frame",
+        pipeline_depth=pipeline,
+    )
+    flow = _CrossingFlow(symbols)
+    sym_names = [f"sym{i}" for i in range(symbols)]
+
+    t0 = time.perf_counter()
+    _svc_warmup(engine, consumer, bus, lambda: flow.frame(batch_n), sym_names)
+    warm_s = time.perf_counter() - t0
+
+    # Private registry: the sweep's stage histograms must not pollute
+    # (or be polluted by) anything else in the process.
+    TRACER.install(FlightRecorder(keep_n=8), registry=Registry())
+    try:
+        # -- closed-loop calibration: the ladder needs a scale ----------
+        from bench import _svc_gateway_step
+
+        cal_frames = [flow.frame(batch_n) for _ in range(24)]
+        t0 = time.perf_counter()
+        done = 0
+        for cols in cal_frames:
+            _svc_gateway_step(cols, sym_names, engine.pre_pool,
+                              bus.order_queue)
+            done += consumer.run_once()
+        done += consumer.drain()
+        cal_s = time.perf_counter() - t0
+        cal_rate = done / cal_s
+        bus.match_queue.commit(bus.match_queue.end_offset())
+        bus.match_queue.compact()
+        bus.order_queue.compact()
+
+        if rates is None:
+            rates = geometric_ladder(
+                0.30 * cal_rate, 1.60 * cal_rate, points
+            )
+        window_s = max(0.8, seconds / len(rates))
+        ladder = [
+            run_single_point(
+                engine, bus, consumer, flow, sym_names,
+                rate=r, window_s=window_s, batch_n=batch_n,
+            )
+            for r in rates
+        ]
+    finally:
+        TRACER.disable()
+
+    config = {
+        "seconds": seconds,
+        "points": len(rates),
+        "window_s": round(window_s, 3),
+        "batch_n": batch_n,
+        "symbols": symbols,
+        "cap": cap,
+        "pipeline_depth": pipeline,
+        "seed": seed,
+        "kernel": kernel,
+        "platform": jax.default_backend(),
+        "warmup_s": round(warm_s, 3),
+        "calibration_orders_per_sec": round(cal_rate, 1),
+        "delivered_floor": delivered_floor,
+        "p99_budget_s": p99_budget_s,
+        "histogram": HIST_KW,
+        "arrival_model": (
+            "open-loop fixed schedule: intended_i = t0 + (i+1)/rate; "
+            "latency charged from intended time"
+        ),
+    }
+    return build_verdict(
+        "single", config, ladder, delivered_floor, p99_budget_s
+    )
+
+
+# ===========================================================================
+# fleet mode (the real 2x2 subprocess fleet; source of CAPACITY_r01.json)
+# ===========================================================================
+
+N_SYMBOLS_FLEET = 16  # <= worker N_LANES so no partition overflows slots
+
+
+def synth_requests(n: int, base: int, fd) -> list[list]:
+    """Bounded-book crossing flow, routed like production: order i takes
+    symbol i % N_SYMBOLS_FLEET, and successive orders on one symbol
+    alternate buy/sale at one price so each pair trades and the book
+    stays ~empty (the sweep must measure rate, not book growth). Returns
+    per-partition lists of (global_index, OrderRequest); global index
+    preserves the open-loop schedule's arrival order."""
+    from gome_tpu.api import order_pb2 as pb
+
+    parts: list[list] = [[] for _ in range(fd.N_PARTITIONS)]
+    for i in range(base, base + n):
+        s = i % N_SYMBOLS_FLEET
+        symbol = f"cap{s:03d}"
+        req = pb.OrderRequest(
+            uuid=f"u{s:03d}",
+            oid=f"o{i:010d}",
+            symbol=symbol,
+            transaction=(i // N_SYMBOLS_FLEET) % 2,
+            price=100.0,
+            volume=1.0,
+            kind=0,
+        )
+        parts[fd.partition_of(symbol)].append((i - base, req))
+    return parts
+
+
+_CONSUMED_RE = re.compile(r"^gome_orders_consumed_total\S* ([0-9eE+.\-]+)$",
+                          re.MULTILINE)
+_DEPTH_RE = re.compile(
+    r'gome_bus_depth\{[^}]*queue="doOrder"[^}]*\} ([0-9eE+.\-]+)'
+)
+_STAGE_SUM_RE = re.compile(
+    r'gome_stage_seconds_sum\{[^}]*stage="([^"]+)"[^}]*\} ([0-9eE+.\-]+)'
+)
+_STAGE_CNT_RE = re.compile(
+    r'gome_stage_seconds_count\{[^}]*stage="([^"]+)"[^}]*\} ([0-9eE+.\-]+)'
+)
+
+
+def _fetch_text(url: str, timeout_s: float = 3.0) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return resp.read().decode()
+
+
+def _parse_consumed(text: str) -> int:
+    m = _CONSUMED_RE.search(text)
+    return int(float(m.group(1))) if m else 0
+
+
+def _parse_depth(text: str) -> float:
+    m = _DEPTH_RE.search(text)
+    return float(m.group(1)) if m else 0.0
+
+
+def _parse_stages(text: str) -> dict:
+    sums = {m.group(1): float(m.group(2))
+            for m in _STAGE_SUM_RE.finditer(text)}
+    cnts = {m.group(1): float(m.group(2))
+            for m in _STAGE_CNT_RE.finditer(text)}
+    return {s: (cnts.get(s, 0.0), sums[s]) for s in sums}
+
+
+class ConsumerSampler(threading.Thread):
+    """Polls each consumer's /metrics on one thread, recording
+    (perf_counter t, orders consumed, doOrder bus depth) triples — the
+    completion-inversion and Little's-law feed for one load point."""
+
+    def __init__(self, urls: dict, interval_s: float = 0.025):
+        super().__init__(name="capacity-sampler", daemon=True)
+        self.urls = urls
+        self.interval_s = interval_s
+        self.samples: dict = {name: [] for name in urls}  # single-writer: run()
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            for name, url in self.urls.items():
+                try:
+                    text = _fetch_text(url + "/metrics", timeout_s=2.0)
+                except Exception:
+                    continue
+                self.samples[name].append((
+                    time.perf_counter(),
+                    _parse_consumed(text),
+                    _parse_depth(text),
+                ))
+            self._halt.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=10)
+
+
+def _interp_consumed(samples: list, t: float) -> float:
+    """Consumed counter value at time t, linear between samples."""
+    if not samples:
+        return 0.0
+    prev = samples[0]
+    if t <= prev[0]:
+        return float(prev[1])
+    for s in samples[1:]:
+        if s[0] >= t:
+            t0, c0 = prev[0], prev[1]
+            t1, c1 = s[0], s[1]
+            if t1 <= t0:
+                return float(c1)
+            return c0 + (c1 - c0) * (t - t0) / (t1 - t0)
+        prev = s
+    return float(prev[1])
+
+
+def _completion_times(samples: list, c_base: int, n: int) -> list[float]:
+    """Invert the consumed counter into per-order completion times:
+    per-partition FIFO means order rank r completes when the counter
+    crosses c_base + r + 1; interpolate within each sample interval."""
+    comp = [0.0] * n
+    filled = 0
+    prev_t, prev_c = samples[0][0], samples[0][1]
+    for t, c, _ in samples[1:]:
+        if c > prev_c:
+            lo = max(prev_c, c_base)
+            hi = min(c, c_base + n)
+            for k in range(lo, hi):
+                frac = (k - prev_c + 0.5) / (c - prev_c)
+                comp[k - c_base] = prev_t + frac * (t - prev_t)
+                filled += 1
+        prev_t, prev_c = t, c
+    last_t = samples[-1][0]
+    for r in range(n):
+        if comp[r] == 0.0:
+            comp[r] = last_t  # sampler tail raced the drain; charge its end
+    return comp
+
+
+def _drive_fleet_partition(target: str, items: list, sched, batch_n: int,
+                           out: dict) -> None:
+    """Open-loop drive of one partition: batches of its orders, each
+    sent at the intended time of its LAST order (send immediately when
+    behind — the backlog is measured, not forgiven)."""
+    import grpc
+
+    from gome_tpu.api import order_pb2 as pb
+    from gome_tpu.api.service import OrderStub
+
+    batches = []
+    accepted = 0
+    try:
+        with grpc.insecure_channel(target) as channel:
+            stub = OrderStub(channel)
+            for i in range(0, len(items), batch_n):
+                chunk = items[i:i + batch_n]
+                due = sched.intended(chunk[-1][0])
+                now = time.perf_counter()
+                if now < due:
+                    time.sleep(due - now)
+                t_send = time.perf_counter()
+                resp = stub.DoOrderBatch(
+                    pb.OrderBatchRequest(orders=[r for _, r in chunk]),
+                    timeout=60,
+                )
+                t_ret = time.perf_counter()
+                accepted += resp.accepted
+                batches.append({
+                    "first_rank": i,
+                    "n": len(chunk),
+                    "due": due,
+                    "t_send": t_send,
+                    "t_ret": t_ret,
+                    "accepted": resp.accepted,
+                })
+    except grpc.RpcError as exc:  # pragma: no cover - transport breach
+        out["transport_error"] = str(exc)
+    out["batches"] = batches
+    out["sent"] = len(items)
+    out["accepted"] = accepted
+
+
+def _await_fleet_drained(sampler_urls: dict, expect: dict,
+                         timeout_s: float) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            ok = all(
+                _parse_consumed(_fetch_text(url + "/metrics")) >= expect[name]
+                for name, url in sampler_urls.items()
+            )
+            if ok:
+                return True
+        except Exception:
+            pass
+        time.sleep(0.1)
+    return False
+
+
+def _timeline_tail(url: str) -> dict:
+    try:
+        doc = _fetch_text(url + "/timeline")
+        samples = json.loads(doc).get("samples") or []
+        if not samples:
+            return {}
+        last = samples[-1]
+        return {
+            "rss_bytes": last.get("rss_bytes"),
+            "nivcsw": last.get("nivcsw"),
+            "cpu_utime_s": last.get("cpu_utime_s"),
+        }
+    except Exception:
+        return {}
+
+
+def run_fleet_point(ctx: dict, rate: float, window_s: float, batch_n: int,
+                    oid_base: int) -> tuple[dict, int]:
+    """One open-loop load point against the live 2x2 fleet. Returns the
+    ladder-point dict and the next oid base."""
+    fd = ctx["fd"]
+    n_point = max(batch_n * fd.N_PARTITIONS, int(rate * window_s))
+    parts = synth_requests(n_point, oid_base, fd)
+    consumed0 = {
+        name: _parse_consumed(_fetch_text(url + "/metrics"))
+        for name, url in ctx["consumers"].items()
+    }
+    stages0 = {
+        name: _parse_stages(_fetch_text(url + "/metrics"))
+        for name, url in ctx["consumers"].items()
+    }
+
+    sampler = ConsumerSampler(ctx["consumers"], interval_s=0.025)
+    sampler.start()
+    time.sleep(0.08)  # at least one pre-drive sample per member
+
+    sched = OpenLoopSchedule(rate, t0=time.perf_counter())
+    drive: dict[int, dict] = {i: {} for i in range(fd.N_PARTITIONS)}
+    threads = [
+        threading.Thread(
+            target=_drive_fleet_partition,
+            args=(ctx["gw_targets"][i], parts[i], sched, batch_n, drive[i]),
+        )
+        for i in range(fd.N_PARTITIONS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    window_end = sched.t0 + n_point / rate
+
+    expect = {
+        f"c{i}": consumed0[f"c{i}"] + len(parts[i])
+        for i in range(fd.N_PARTITIONS)
+    }
+    drained = _await_fleet_drained(
+        ctx["consumers"], expect, timeout_s=max(120.0, 4 * window_s)
+    )
+    time.sleep(0.1)  # let the sampler catch the final counter value
+    sampler.stop()
+
+    # -- per-order latency via counter inversion -------------------------
+    per_part_hists = []
+    corrected, closed = _hist(), _hist()
+    delivered_rates = []
+    depth_means = {}
+    little_wait = []
+    point_orders = []
+    for i in range(fd.N_PARTITIONS):
+        name = f"c{i}"
+        samples = sampler.samples[name]
+        if not samples or not parts[i]:
+            per_part_hists.append((_hist(), _hist()))
+            continue
+        comp = _completion_times(samples, consumed0[name], len(parts[i]))
+        pc, pl = _hist(), _hist()
+        send_t = {}
+        for b in drive[i].get("batches", []):
+            for r in range(b["first_rank"], b["first_rank"] + b["n"]):
+                send_t[r] = b["t_send"]
+        for r, (gi, _req) in enumerate(parts[i]):
+            pc.record(max(comp[r] - sched.intended(gi), 0.0))
+            pl.record(max(comp[r] - send_t.get(r, sched.t0), 0.0))
+        per_part_hists.append((pc, pl))
+        # Steady-state delivered: slope of the consumed counter between
+        # its first and last increase. Counting from t0 would charge
+        # batch accumulation; cutting at window_end would drop the
+        # in-flight tail — either one fakes a knee at low load.
+        inc = [
+            k for k in range(1, len(samples))
+            if samples[k][1] > samples[k - 1][1]
+        ]
+        if (len(inc) >= 2
+                and samples[inc[-1]][1] > samples[inc[0]][1]
+                and samples[inc[-1]][0] > samples[inc[0]][0]):
+            delivered_rates.append(
+                (samples[inc[-1]][1] - samples[inc[0]][1])
+                / (samples[inc[-1]][0] - samples[inc[0]][0])
+            )
+        else:
+            delivered_rates.append(
+                len(parts[i]) / max(comp[-1] - sched.t0, 1e-9)
+            )
+        depths = [d for (t, _c, d) in samples if t <= comp[-1]]
+        depth_means[name] = (
+            sum(depths) / len(depths) if depths else 0.0
+        )
+        span = max(comp[-1] - sched.t0, 1e-9)
+        little_wait.append(
+            (len(parts[i]),
+             depth_means[name] / (len(parts[i]) / span))
+        )
+        point_orders.append((i, comp))
+    for pc, pl in per_part_hists:
+        corrected.merge(pc)
+        closed.merge(pl)
+    # Cross-process merge proof: the merged recorder must equal the sum
+    # of its parts (integer-count state makes this exact).
+    merge_lossless = corrected.count == sum(
+        pc.count for pc, _ in per_part_hists
+    )
+
+    elapsed_offer = window_end - sched.t0
+    delivered_per_sec = sum(delivered_rates)
+
+    # -- exactly-once: cumulative match-queue seq audit -------------------
+    audits = []
+    events_total = 0
+    for i in range(fd.N_PARTITIONS):
+        n_events, seqs = fd.read_match_seqs(ctx["bus_dirs"][i])
+        audit = fd.audit_seqs(seqs)
+        events_total += n_events
+        audits.append({
+            "partition": i,
+            "events": n_events,
+            "stamped": len(seqs),
+            "dupes": audit.get("dupes", 0),
+            "gaps": audit.get("gaps", 0),
+        })
+    accepted = sum(drive[i].get("accepted", 0)
+                   for i in range(fd.N_PARTITIONS))
+    exactly_once = {
+        "method": "matchfeed seq audit (cumulative) + conservation",
+        "sent": n_point,
+        "accepted": accepted,
+        "events": events_total,
+        "dupes": sum(a["dupes"] for a in audits),
+        "gaps": sum(a["gaps"] for a in audits),
+        "drained": drained and accepted == n_point,
+        "partitions": audits,
+    }
+
+    # -- attribution ------------------------------------------------------
+    stages1 = {
+        name: _parse_stages(_fetch_text(url + "/metrics"))
+        for name, url in ctx["consumers"].items()
+    }
+    all_batches = [
+        b for i in range(fd.N_PARTITIONS)
+        for b in drive[i].get("batches", [])
+    ]
+    n_sent_batched = sum(b["n"] for b in all_batches) or 1
+    # Exact pre-send decomposition: mean over orders of (due - intended)
+    # is the accumulation wait; (t_send - due) is the backlog.
+    accum = 0.0
+    for i in range(fd.N_PARTITIONS):
+        for b in drive[i].get("batches", []):
+            lo = b["first_rank"]
+            for r in range(lo, lo + b["n"]):
+                gi = parts[i][r][0]
+                accum += b["due"] - sched.intended(gi)
+    accum /= n_sent_batched
+    backlog = sum(
+        (b["t_send"] - b["due"]) * b["n"] for b in all_batches
+    ) / n_sent_batched
+    # An order's latency path only includes admission up to ITS slot of
+    # the serial per-order scalar path inside the RPC — the mean slot is
+    # (n+1)/2n of the wall; charging the full wall per order would
+    # double-count the tail of every batch.
+    admit = sum(
+        (b["t_ret"] - b["t_send"]) * (b["n"] + 1) / 2
+        for b in all_batches
+    ) / n_sent_batched
+    admit_busy = [
+        sum(b["t_ret"] - b["t_send"] for b in drive[i].get("batches", []))
+        for i in range(fd.N_PARTITIONS)
+    ]
+    busy_end = max(
+        (comp[-1] for _i, comp in point_orders), default=window_end
+    )
+    busy_s = max(busy_end - sched.t0, 1e-9)
+    bus_wait = (
+        sum(n * w for n, w in little_wait) / sum(n for n, _ in little_wait)
+        if little_wait else 0.0
+    )
+    rows = [
+        {
+            "stage": "arrival_accumulation",
+            "seconds_per_order": accum,
+            "utilization": None,
+            "source": "exact (batch due - per-order intended)",
+        },
+        {
+            "stage": "send_backlog",
+            "seconds_per_order": backlog,
+            "utilization": None,
+            "source": "driver (batch send - batch due)",
+        },
+        {
+            "stage": "admit",
+            "seconds_per_order": admit,
+            "utilization": max(w / busy_s for w in admit_busy),
+            "source": "driver (DoOrderBatch RPC wall, mean-slot share)",
+        },
+        {
+            "stage": "bus_wait",
+            "seconds_per_order": bus_wait,
+            "utilization": None,
+            "source": "Little's law on sampled gome_bus_depth{doOrder}",
+        },
+    ]
+    stage_names = sorted({
+        s for d in stages1.values() for s in d
+    })
+    for stage in stage_names:
+        dc = ds = 0.0
+        per_member_busy = []
+        for name in ctx["consumers"]:
+            c0, s0 = stages0.get(name, {}).get(stage, (0.0, 0.0))
+            c1, s1 = stages1.get(name, {}).get(stage, (0.0, 0.0))
+            dc += c1 - c0
+            ds += s1 - s0
+            per_member_busy.append((s1 - s0) / busy_s)
+        if dc <= 0:
+            continue
+        # Wait-like stages (queue transit etc.) overlap across in-flight
+        # orders — their span-sum over wall is occupancy of nothing, so
+        # they don't compete for "saturated stage".
+        busy_like = stage not in _WAIT_STAGES
+        rows.append({
+            "stage": stage,
+            "seconds_per_order": ds / dc,
+            "utilization": max(per_member_busy) if busy_like else None,
+            "source": "consumer gome_stage_seconds delta / spans",
+        })
+    attr = attribution_check(rows, corrected.mean(), tol=0.05)
+    attr["rows"] = rows
+    attr["note"] = (
+        "bus_wait (Little's law) and the consumer stage spans overlap by "
+        "up to one in-flight batch; the sum check tolerates it at 5%"
+    )
+
+    host = {
+        name: _timeline_tail(url) for name, url in ctx["consumers"].items()
+    }
+    point = {
+        "offered_per_sec": rate,
+        "delivered_per_sec": round(delivered_per_sec, 2),
+        "delivered_frac": round(delivered_per_sec / rate, 4),
+        "sent": n_point,
+        "orders_per_partition": [len(p) for p in parts],
+        "batch_n": batch_n,
+        "window_s": round(elapsed_offer, 3),
+        "send_backlog_s_mean": round(backlog, 6),
+        "corrected": _lat_summary(corrected),
+        "closed_loop": _lat_summary(closed),
+        "merge_lossless": merge_lossless,
+        "exactly_once": exactly_once,
+        "attribution": attr,
+        "host": host,
+        "bus_depth_mean": {
+            k: round(v, 2) for k, v in depth_means.items()
+        },
+    }
+    return point, oid_base + n_point
+
+
+def run_fleet_sweep(args) -> dict:
+    """Start the real 2x2 fleet (fleet_drill's own workers), warm it,
+    calibrate, run the ladder, and assemble the verdict."""
+    import importlib.util
+    import tempfile
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_drill", os.path.join(REPO, "scripts", "fleet_drill.py")
+    )
+    fd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fd)
+
+    work = args.workdir or tempfile.mkdtemp(prefix="gome-capacity-")
+    os.makedirs(work, exist_ok=True)
+    drill_py = os.path.join(REPO, "scripts", "fleet_drill.py")
+
+    resp = None
+    workers: dict = {}
+    try:
+        resp = fd.start_respserver(work)
+        bus_dirs = []
+        for i in range(fd.N_PARTITIONS):
+            bus_dir = os.path.join(work, f"p{i}", "bus")
+            os.makedirs(bus_dir, exist_ok=True)
+            bus_dirs.append(bus_dir)
+            for role in ("consumer", "gateway"):
+                name = ("c" if role == "consumer" else "gw") + str(i)
+                workers[name] = fd.Worker(name, [
+                    sys.executable, drill_py,
+                    "--worker", role,
+                    "--bus-dir", bus_dir,
+                    "--resp-port", str(resp.resp_port),
+                    "--partition", str(i),
+                    "--result", os.path.join(work, f"{name}_result.json"),
+                ])
+        for name, w in workers.items():
+            w.await_ready()
+            print(f"capacity: {name} ready (ops={w.ports['ops']}, "
+                  f"grpc={w.ports['grpc']})")
+        ctx = {
+            "fd": fd,
+            "bus_dirs": bus_dirs,
+            "gw_targets": {
+                i: f"127.0.0.1:{workers[f'gw{i}'].ports['grpc']}"
+                for i in range(fd.N_PARTITIONS)
+            },
+            "consumers": {
+                f"c{i}": f"http://127.0.0.1:{workers[f'c{i}'].ports['ops']}"
+                for i in range(fd.N_PARTITIONS)
+            },
+        }
+
+        # -- warm-up: force the compiles off the measured ladder ---------
+        oid_base = 0
+        warm_parts = synth_requests(128, oid_base, fd)
+        oid_base += 128
+        warm_sched = OpenLoopSchedule(1e9, t0=time.perf_counter())
+        warm_out: dict[int, dict] = {i: {} for i in range(fd.N_PARTITIONS)}
+        for i in range(fd.N_PARTITIONS):
+            _drive_fleet_partition(
+                ctx["gw_targets"][i], warm_parts[i], warm_sched,
+                args.batch_n, warm_out[i],
+            )
+        expect = {
+            f"c{i}": len(warm_parts[i]) for i in range(fd.N_PARTITIONS)
+        }
+        warm_ok = _await_fleet_drained(
+            ctx["consumers"], expect, timeout_s=240.0
+        )
+        print(f"capacity: warm-up drained={warm_ok}")
+
+        # -- closed-loop calibration burst: the ladder needs a scale -----
+        n_cal = 512
+        cal_parts = synth_requests(n_cal, oid_base, fd)
+        oid_base += n_cal
+        cal_sched = OpenLoopSchedule(1e9, t0=time.perf_counter())
+        cal_out: dict[int, dict] = {i: {} for i in range(fd.N_PARTITIONS)}
+        t0 = time.perf_counter()
+        cal_threads = [
+            threading.Thread(
+                target=_drive_fleet_partition,
+                args=(ctx["gw_targets"][i], cal_parts[i], cal_sched,
+                      args.batch_n, cal_out[i]),
+            )
+            for i in range(fd.N_PARTITIONS)
+        ]
+        for t in cal_threads:
+            t.start()
+        for t in cal_threads:
+            t.join()
+        expect = {
+            f"c{i}": expect[f"c{i}"] + len(cal_parts[i])
+            for i in range(fd.N_PARTITIONS)
+        }
+        _await_fleet_drained(ctx["consumers"], expect, timeout_s=240.0)
+        cal_rate = n_cal / (time.perf_counter() - t0)
+        print(f"capacity: calibration {cal_rate:.0f} orders/s closed-loop")
+
+        rates = (
+            [float(r) for r in args.rates.split(",")] if args.rates
+            else geometric_ladder(
+                0.30 * cal_rate, 1.60 * cal_rate, args.points
+            )
+        )
+        ladder = []
+        for r in rates:
+            point, oid_base = run_fleet_point(
+                ctx, rate=r, window_s=args.window, batch_n=args.batch_n,
+                oid_base=oid_base,
+            )
+            ladder.append(point)
+            print(
+                f"capacity: offered {r:7.1f}/s delivered "
+                f"{point['delivered_per_sec']:7.1f}/s "
+                f"corrected p99 {point['corrected']['p99_s'] * 1e3:.0f}ms"
+            )
+            time.sleep(0.5)  # settle between points
+    finally:
+        for name, w in workers.items():
+            w.stop()
+        if resp is not None:
+            resp.kill()
+            resp.wait(timeout=10)
+
+    config = {
+        "partitions": fd.N_PARTITIONS,
+        "symbols": N_SYMBOLS_FLEET,
+        "batch_n": args.batch_n,
+        "window_s": args.window,
+        "points": len(rates),
+        "calibration_orders_per_sec": round(cal_rate, 1),
+        "delivered_floor": args.delivered_floor,
+        "p99_budget_s": args.p99_budget_s,
+        "histogram": HIST_KW,
+        "engine": {
+            "n_slots": fd.N_LANES, "max_t": fd.T_BINS,
+            "cap": 64, "max_fills": 8, "dtype": "int64",
+        },
+        "drive": (
+            "per-partition columnar DoOrderBatch over gRPC, routed by "
+            "fleet.partition_of; gateways run with the tracer armed so "
+            "admission takes the per-order scalar path (same workers as "
+            "FLEET_r01)"
+        ),
+        "completion_source": (
+            "gome_orders_consumed_total polled at 25 ms, inverted via "
+            "per-partition FIFO with linear interpolation"
+        ),
+        "arrival_model": (
+            "open-loop fixed schedule: intended_i = t0 + (i+1)/rate; "
+            "latency charged from intended time"
+        ),
+    }
+    extra = {
+        "merge_lossless_all_points": all(
+            p.get("merge_lossless") for p in ladder
+        ),
+    }
+    return build_verdict(
+        "fleet", config, ladder, args.delivered_floor, args.p99_budget_s,
+        extra_checks=extra,
+    )
+
+
+# ===========================================================================
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--fleet", action="store_true",
+                    help="sweep the real 2x2 subprocess fleet "
+                         "(default: in-process single service)")
+    ap.add_argument("--seconds", type=float, default=10.0,
+                    help="single mode: total sweep budget (window = "
+                         "budget / points)")
+    ap.add_argument("--window", type=float, default=4.0,
+                    help="fleet mode: offered window per ladder point (s)")
+    ap.add_argument("--points", type=int, default=6,
+                    help="ladder points (>= 5 for the committed verdict)")
+    ap.add_argument("--rates", default="",
+                    help="comma list of offered rates (orders/s); "
+                         "default: geometric 0.3x..1.6x of calibration")
+    ap.add_argument("--batch-n", type=int, default=0,
+                    help="orders per DoOrderBatch / frame (default: 256 "
+                         "single, 32 fleet — small fleet batches keep "
+                         "accumulation delay from burying the curve)")
+    ap.add_argument("--symbols", type=int, default=32,
+                    help="single mode: engine symbol slots")
+    ap.add_argument("--cap", type=int, default=128,
+                    help="single mode: book cap")
+    ap.add_argument("--pipeline", type=int, default=2,
+                    help="single mode: consumer pipeline depth")
+    ap.add_argument("--seed", type=int, default=17)
+    ap.add_argument("--delivered-floor", type=float, default=0.98,
+                    help="knee rule: delivered/offered below this is "
+                         "saturation")
+    ap.add_argument("--p99-budget-s", type=float, default=1.0,
+                    help="knee rule: corrected p99 above this is "
+                         "saturation")
+    ap.add_argument("--workdir", default="",
+                    help="fleet mode scratch dir (default: tempdir)")
+    ap.add_argument("--out", default="",
+                    help="verdict JSON path (default: CAPACITY_r01.json "
+                         "for --fleet, capacity_smoke.json otherwise)")
+    args = ap.parse_args(argv)
+    out = args.out or (
+        "CAPACITY_r01.json" if args.fleet else "capacity_smoke.json"
+    )
+    if not args.batch_n:
+        args.batch_n = 32 if args.fleet else 256
+
+    if args.fleet:
+        verdict = run_fleet_sweep(args)
+    else:
+        rates = (
+            [float(r) for r in args.rates.split(",")] if args.rates else None
+        )
+        verdict = run_single_sweep(
+            seconds=args.seconds, points=args.points,
+            symbols=args.symbols, cap=args.cap, batch_n=args.batch_n,
+            pipeline=args.pipeline, seed=args.seed,
+            delivered_floor=args.delivered_floor,
+            p99_budget_s=args.p99_budget_s, rates=rates,
+        )
+    write_json(out, verdict)
+    print_verdict(verdict, out)
+    return 0 if verdict["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -228,14 +228,17 @@ def record_sim_frames(seed: int, n_steps: int) -> list[bytes]:
 
 def requests_from_frames(frames: list[bytes]) -> list[list]:
     """Decode recorded GCO frames into per-partition gRPC request
-    streams: [(is_cancel, OrderRequest), ...] per partition, global
-    arrival order preserved inside each partition (the ADD-before-DEL
-    sequencing contract only spans one symbol, and a symbol maps to
-    exactly one partition)."""
+    streams: [(global_idx, is_cancel, OrderRequest), ...] per partition,
+    global arrival order preserved inside each partition (the
+    ADD-before-DEL sequencing contract only spans one symbol, and a
+    symbol maps to exactly one partition). global_idx is the order's
+    rank in the SIM's arrival stream — the open-loop scheduler's clock
+    ticks on it, so both partitions share one arrival process."""
     from gome_tpu.api import order_pb2 as pb
     from gome_tpu.bus.colwire import decode_order_frame
 
     parts: list[list] = [[] for _ in range(N_PARTITIONS)]
+    gi = 0
     for fr in frames:
         cols = decode_order_frame(fr)
         symbols, uuids = cols["symbols"], cols["uuids"]
@@ -253,7 +256,8 @@ def requests_from_frames(frames: list[bytes]) -> list[list]:
                 volume=float(int(cols["volume"][i])),
                 kind=int(cols["kind"][i]),
             )
-            parts[partition_of(symbol)].append((action == 2, req))
+            parts[partition_of(symbol)].append((gi, action == 2, req))
+            gi += 1
     return parts
 
 
@@ -263,14 +267,23 @@ def requests_from_frames(frames: list[bytes]) -> list[list]:
 DRIVE_BATCH_N = 1024
 
 
-def drive_partition(target: str, reqs: list, out: dict) -> None:
+def drive_partition(target: str, reqs: list, out: dict,
+                    sched=None, rank=None,
+                    batch_n: int = DRIVE_BATCH_N) -> None:
     """Chunked gRPC drive of one partition's gateway through the
     columnar batch front door (round 11): DoOrderBatch with per-chunk
     cancel masks, arrival order preserved (adds and cancels ride the
     SAME request stream, so the ADD-before-DEL sequencing contract
     holds exactly as it did under per-order DoOrder). Tallies per-order
     response codes (accepted entries count as code 0, rejects by their
-    per-order code); any transport error is recorded, not raised."""
+    per-order code); any transport error is recorded, not raised.
+
+    With ``sched`` (an ``OpenLoopSchedule``) the drive is RATE
+    CONTROLLED (ISSUE 17): each chunk waits for the intended arrival
+    time of its last order (``rank`` maps global order index ->
+    schedule tick), and is sent immediately when behind — the backlog
+    is the system's to answer for, never forgiven. Without it, the
+    legacy closed-loop fire-hose."""
     import grpc
 
     from gome_tpu.api import order_pb2 as pb
@@ -281,11 +294,20 @@ def drive_partition(target: str, reqs: list, out: dict) -> None:
     try:
         with grpc.insecure_channel(target) as channel:
             stub = OrderStub(channel)
-            for i in range(0, len(reqs), DRIVE_BATCH_N):
-                chunk = reqs[i : i + DRIVE_BATCH_N]
+            for i in range(0, len(reqs), batch_n):
+                chunk = reqs[i : i + batch_n]
+                if sched is not None:
+                    due = sched.intended(
+                        max(rank[g] for g, _, _ in chunk)
+                        if rank is not None
+                        else max(g for g, _, _ in chunk)
+                    )
+                    now = time.perf_counter()
+                    if now < due:
+                        time.sleep(due - now)
                 breq = pb.OrderBatchRequest(
-                    orders=[r for _, r in chunk],
-                    cancel=[c for c, _ in chunk],
+                    orders=[r for _, _, r in chunk],
+                    cancel=[c for _, c, _ in chunk],
                 )
                 resp = stub.DoOrderBatch(breq, timeout=30)
                 codes[0] = codes.get(0, 0) + resp.accepted
@@ -446,7 +468,7 @@ def run_parent(args) -> int:
     parts = requests_from_frames(frames)
     n_orders = sum(len(p) for p in parts)
     sym_counts = [
-        len({r.symbol for _, r in p}) for p in parts
+        len({r.symbol for _, _, r in p}) for p in parts
     ]
     print(
         f"fleet: {len(frames)} frames / {n_orders} orders -> "
@@ -485,13 +507,14 @@ def run_parent(args) -> int:
         FLEET.install(members, interval_s=0.25, timeout_s=2.0)
         FLEET.start()
 
-        def drive_all(slices: list, out: dict) -> None:
+        def drive_all(slices: list, out: dict, sched=None,
+                      rank=None, batch_n: int = DRIVE_BATCH_N) -> None:
             threads = [
                 threading.Thread(
                     target=drive_partition,
                     args=(
                         f"127.0.0.1:{workers[f'gw{i}'].ports['grpc']}",
-                        slices[i], out[f"gw{i}"],
+                        slices[i], out[f"gw{i}"], sched, rank, batch_n,
                     ),
                 )
                 for i in range(N_PARTITIONS)
@@ -516,13 +539,32 @@ def run_parent(args) -> int:
         print(f"fleet: warm-up {warm_n} drained={warm_drained}")
 
         # -- measured drive of both partitions concurrently over gRPC ---
+        # Rate controlled (ISSUE 17): a shared OpenLoopSchedule at
+        # --rate ticks on the SIM's global arrival order (warm-up slice
+        # re-ranked out), so both gateways see one coherent open-loop
+        # arrival process. The old fire-hose drive made the verdict's
+        # orders/s an artifact of feed size, not a chosen offered rate.
+        measured_slices = [parts[i][warm_n[i]:] for i in range(N_PARTITIONS)]
+        rank = {
+            gi: k for k, gi in enumerate(sorted(
+                gi for sl in measured_slices for gi, _, _ in sl
+            ))
+        }
+        sched = None
+        if args.rate > 0:
+            from gome_tpu.obs.capacity import OpenLoopSchedule
+
+            sched = OpenLoopSchedule(args.rate, t0=time.perf_counter())
         drive: dict[str, dict] = {f"gw{i}": {} for i in range(N_PARTITIONS)}
         t0 = time.perf_counter()
-        drive_all([parts[i][warm_n[i]:] for i in range(N_PARTITIONS)], drive)
+        drive_all(measured_slices, drive, sched=sched, rank=rank,
+                  batch_n=args.drive_batch)
         drive_wall = time.perf_counter() - t0
         n_measured = n_orders - sum(warm_n)
         print(f"fleet: drive done in {drive_wall:.2f}s "
-              f"({n_measured / drive_wall:.0f} orders/s aggregate)")
+              f"({n_measured / drive_wall:.0f} orders/s aggregate, "
+              f"offered rate "
+              f"{args.rate if args.rate > 0 else 'closed-loop'})")
 
         # -- drain, then hold a steady observation window ---------------
         drained = [
@@ -719,6 +761,20 @@ def run_parent(args) -> int:
             "partitions": N_PARTITIONS,
             "orders_per_partition": [len(p) for p in parts],
             "symbols_per_partition": sym_counts,
+            "drive": {
+                "mode": "open-loop" if args.rate > 0 else "closed-loop",
+                "rate_per_sec": args.rate if args.rate > 0 else None,
+                "batch_n": args.drive_batch,
+                "scheduler": (
+                    "gome_tpu.obs.capacity.OpenLoopSchedule"
+                    if args.rate > 0 else None
+                ),
+                "note": (
+                    "orders_per_sec in this verdict reflects the CHOSEN "
+                    "offered drive rate, not fleet capacity — the "
+                    "measured saturation knee lives in CAPACITY_r01.json"
+                ),
+            },
             "engine": {
                 "n_slots": N_LANES, "max_t": T_BINS,
                 "cap": 64, "max_fills": 8, "dtype": "int64",
@@ -776,6 +832,14 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seconds", type=int, default=30,
                     help="drill scale knob: sim steps = seconds*8 (clamped)")
+    ap.add_argument("--rate", type=float, default=600.0,
+                    help="open-loop offered drive rate, aggregate "
+                         "orders/s (0 = legacy closed-loop fire-hose); "
+                         "default sits below the CAPACITY_r01 knee so "
+                         "the drill measures a healthy fleet")
+    ap.add_argument("--drive-batch", type=int, default=0,
+                    help="orders per DoOrderBatch (default: 64 "
+                         "rate-controlled, 1024 closed-loop)")
     ap.add_argument("--seed", type=int, default=13)
     ap.add_argument("--out", default="FLEET_r01.json",
                     help="verdict JSON path (parent mode)")
@@ -791,6 +855,8 @@ def main(argv=None) -> int:
                     help=argparse.SUPPRESS)
     ap.add_argument("--result", default="", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if not args.drive_batch:
+        args.drive_batch = 64 if args.rate > 0 else DRIVE_BATCH_N
     if args.worker == "gateway":
         return run_gateway_worker(args)
     if args.worker == "consumer":

@@ -36,6 +36,13 @@ in-process service stack and dump the operator surfaces to files —
                           counter-summed / proc-labeled merged
                           exposition, fleet-wide seq audit) over a
                           scripted two-member view of this process
+  <out_dir>/capacity.json the /capacity payload (round 13): a real
+                          ~10-second single-process smoke sweep of
+                          scripts/capacity.py's open-loop ladder —
+                          corrected percentiles, knee detection, and
+                          the bottleneck-attribution table, asserted
+                          at capture time to have non-empty rows that
+                          sum back to e2e latency at every point
 
     python scripts/obs_snapshot.py [out_dir=obs-artifacts]
 
@@ -308,6 +315,53 @@ def main(out_dir: str = "obs-artifacts") -> int:
         json.dump(fleet_doc, f, indent=1, default=str)
     FLEET.disable()
 
+    # The /capacity payload (round 13): run the REAL smoke ladder —
+    # scripts/capacity.py's open-loop single-process sweep, the same
+    # entry point the CI capacity gate drives — and install the fresh
+    # verdict into the CAPACITY singleton so the artifact is produced
+    # by the same code path the HTTP endpoint serves. The sweep builds
+    # its own engine/bus/consumer and arms a PRIVATE tracer (disabling
+    # it on exit), so park this process's boot recorder around the call
+    # exactly like the columnar drill above.
+    import importlib.util
+
+    from gome_tpu.obs.capacity import CAPACITY
+
+    cap_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "capacity.py")
+    spec = importlib.util.spec_from_file_location("_cap_sweep", cap_py)
+    cap_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cap_mod)
+    _recorder = TRACER.recorder
+    TRACER.disable()
+    try:
+        cap_verdict = cap_mod.run_single_sweep(
+            seconds=10.0, points=5, symbols=16, cap=64, batch_n=256,
+        )
+    finally:
+        TRACER.recorder = _recorder
+    assert len(cap_verdict["ladder"]) >= 5, cap_verdict["ladder"]
+    for pt in cap_verdict["ladder"]:
+        attr = pt["attribution"]
+        assert attr["rows"], (
+            f"empty attribution at {pt['offered_per_sec']}/s"
+        )
+        assert attr["within_tol"], (
+            f"attribution misses e2e latency at {pt['offered_per_sec']}/s: "
+            f"frac_err={attr['frac_err']}"
+        )
+    assert cap_verdict["checks"]["exactly_once_all_points"], cap_verdict
+    CAPACITY.install(cap_verdict)
+    capacity_doc = ops.capacity_payload()
+    assert capacity_doc["enabled"], "capacity verdict did not arm"
+    assert capacity_doc["verdict"]["schema"] == cap_verdict["schema"]
+    cap_metrics = REGISTRY.render()
+    assert "gome_capacity_points" in cap_metrics, "capacity gauges missing"
+    with open(os.path.join(out_dir, "capacity.json"), "w") as f:
+        json.dump(capacity_doc, f, indent=1, default=str)
+    cap_knee = cap_verdict["knee"]
+    CAPACITY.disable()
+
     journeys = {
         ev["args"]["trace_id"]
         for ev in dump["traceEvents"]
@@ -329,7 +383,12 @@ def main(out_dir: str = "obs-artifacts") -> int:
         f"{cdrill['admit_ns_per_order']} ns/order columnar admit at "
         f"{cdrill['coverage_pct']}% coverage), "
         f"{out_dir}/fleet.json ({len(fleet_doc['members'])} members, "
-        f"{len(fleet_metrics['families'])} merged families)"
+        f"{len(fleet_metrics['families'])} merged families), "
+        f"{out_dir}/capacity.json ({capacity_doc['points']} ladder "
+        f"points, knee "
+        + (f"at {cap_knee['offered_per_sec']:.0f}/s offered"
+           if cap_knee.get("found") else "not reached")
+        + f", saturated stage: {cap_knee.get('saturated_stage')})"
     )
     JOURNAL.disable()
     TIMELINE.disable()

@@ -340,6 +340,42 @@ def fleet_chaos_advisory() -> dict:
         return {"fleet_chaos.advisory_error": f"{type(exc).__name__}: {exc}"}
 
 
+def capacity_advisory() -> dict:
+    """Capacity-observatory surface (ISSUE 17), ADVISORY only —
+    wall-clock (never gated; the knee moves with the CI box, and a
+    throughput number that can fail a build invites gaming the sweep).
+
+    Sourced from the committed capacity verdict (CAPACITY_r01.json at
+    the repo root, regenerated by ``scripts/capacity.py --fleet``):
+    delivered throughput at the saturation knee, the corrected
+    (coordinated-omission-safe) p99 at the ladder point nearest HALF
+    the knee's offered rate (the healthy-operating-region latency a
+    deployment should plan around), the stage the attribution table
+    blames at the knee, and the verdict outcome."""
+    try:
+        path = os.path.join(ROOT, "CAPACITY_r01.json")
+        with open(path) as f:
+            verdict = json.load(f)
+        knee = verdict["knee"]
+        ladder = verdict["ladder"]
+        half = knee["offered_per_sec"] / 2.0
+        half_pt = min(
+            ladder, key=lambda p: abs(p["offered_per_sec"] - half)
+        )
+        return {
+            "capacity.knee_offered_per_sec": knee["offered_per_sec"],
+            "capacity.knee_delivered_per_sec": knee["delivered_per_sec"],
+            "capacity.corrected_p99_ms_at_half_knee": round(
+                half_pt["corrected"]["p99_s"] * 1e3, 1
+            ),
+            "capacity.saturated_stage": knee["saturated_stage"],
+            "capacity.ladder_points": len(ladder),
+            "capacity.verdict_pass": bool(verdict["pass"]),
+        }
+    except Exception as exc:  # pragma: no cover - env-specific
+        return {"capacity.advisory_error": f"{type(exc).__name__}: {exc}"}
+
+
 def collect() -> dict:
     """{"jax": version, "gated": {...}, "advisory": {...}}."""
     import jax
@@ -358,6 +394,7 @@ def collect() -> dict:
     advisory.update(recovery_advisory())
     advisory.update(fleet_advisory())
     advisory.update(fleet_chaos_advisory())
+    advisory.update(capacity_advisory())
     return {
         "jax": jax.__version__,
         "gated": gated,
@@ -558,6 +595,26 @@ def main(argv: list[str] | None = None) -> int:
             "# WARNING (advisory, non-gating): the committed fleet "
             "verdict has pass=false — tests/test_fleet.py should be "
             "failing; investigate before trusting fleet numbers"
+        )
+    knee_rate = current["advisory"].get("capacity.knee_delivered_per_sec")
+    if knee_rate is not None:
+        print(
+            f"# ADVISORY (never gated, wall-clock): fleet saturation "
+            f"knee at {knee_rate} delivered orders/sec "
+            f"(offered "
+            f"{current['advisory'].get('capacity.knee_offered_per_sec')}"
+            f"/s), corrected p99 at half-knee load "
+            f"{current['advisory'].get('capacity.corrected_p99_ms_at_half_knee')}"
+            f" ms, saturated stage: "
+            f"{current['advisory'].get('capacity.saturated_stage')} "
+            "(CAPACITY_r01.json; regenerate with scripts/capacity.py "
+            "--fleet)"
+        )
+    if current["advisory"].get("capacity.verdict_pass") is False:
+        print(
+            "# WARNING (advisory, non-gating): the committed capacity "
+            "verdict has pass=false — tests/test_capacity.py should be "
+            "failing; investigate before trusting capacity numbers"
         )
     if regressions:
         print(f"perf_ratchet: {len(regressions)} regressed metric(s):")

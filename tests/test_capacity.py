@@ -1,0 +1,376 @@
+"""Capacity observatory (ISSUE 17): the coordinated-omission-safe
+LogHistogram (bounded relative error, byte-stable serialize, exactly
+associative cross-process merge), the open-loop arrival schedule, knee
+detection + attribution helpers, the CAPACITY singleton + /capacity ops
+payload, and the committed fleet sweep verdict (CAPACITY_r01.json,
+produced by ``scripts/capacity.py --fleet``)."""
+
+import json
+import math
+import os
+import random
+
+import pytest
+
+from gome_tpu.obs.capacity import (
+    CAPACITY,
+    SCHEMA,
+    CapacityObservatory,
+    LogHistogram,
+    OpenLoopSchedule,
+    attribution_check,
+    find_knee,
+    load_verdict,
+    monotone_ladder,
+    saturated_stage,
+)
+from gome_tpu.utils.metrics import Registry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- LogHistogram: bounded relative error ------------------------------------
+
+
+def test_relative_error_bound_property():
+    """The histogram's core contract: for every in-range value, the
+    bucket estimate (geometric mean of the bucket bounds) is within the
+    configured relative error — estimate/v in (1/(1+e), 1+e]."""
+    rel_err = 0.01
+    h = LogHistogram(rel_err=rel_err, min_value=1e-6, max_value=600.0)
+    rng = random.Random(17)
+    for _ in range(20_000):
+        # log-uniform across the full dynamic range
+        v = 10 ** rng.uniform(-6, math.log10(600.0) - 1e-9)
+        est = h.bucket_estimate(h.index(v))
+        ratio = est / v
+        assert 1.0 / (1.0 + rel_err) < ratio <= 1.0 + rel_err, (v, est)
+
+
+def test_underflow_and_clamp_buckets():
+    h = LogHistogram(rel_err=0.05, min_value=1e-3, max_value=10.0)
+    assert h.index(0.0) == 0
+    assert h.index(-1.0) == 0
+    assert h.index(float("nan")) == 0
+    assert h.index(1e-9) == 0
+    # overflow clamps to the top bucket, whose estimate is >= max_value
+    top = h.index(1e9)
+    assert top == h.index(10.0 * 1.2)
+    h.record(1e9)
+    assert h.percentile(0.5) >= 10.0
+
+
+def test_mean_tracks_true_mean_within_rel_err():
+    h = LogHistogram(rel_err=0.01, min_value=1e-6, max_value=600.0)
+    rng = random.Random(7)
+    vals = [rng.uniform(0.001, 2.0) for _ in range(5000)]
+    for v in vals:
+        h.record(v)
+    true_mean = sum(vals) / len(vals)
+    assert abs(h.mean() - true_mean) / true_mean < 0.01
+
+
+# -- LogHistogram: merge + serialize -----------------------------------------
+
+
+def test_cross_process_merge_equals_single_recording():
+    """Split one recording across two histograms (as two processes
+    would), merge, and the result must be EXACTLY the single-process
+    recording — same counts, same percentiles, same bytes. Integer
+    bucket counts make merge associative; a float accumulator would
+    break byte equality on fold order."""
+    rng = random.Random(23)
+    vals = [10 ** rng.uniform(-5, 2) for _ in range(4096)]
+    single = LogHistogram()
+    a, b = LogHistogram(), LogHistogram()
+    for v in vals:
+        single.record(v)
+    for v in vals[:1500]:
+        a.record(v)
+    for v in vals[1500:]:
+        b.record(v)
+    a.merge(b)
+    assert a.count == single.count == len(vals)
+    assert a.to_bytes() == single.to_bytes()
+    assert a.percentiles() == single.percentiles()
+    assert a.mean() == single.mean()
+
+
+def test_merge_rejects_geometry_mismatch():
+    a = LogHistogram(rel_err=0.01)
+    b = LogHistogram(rel_err=0.02)
+    with pytest.raises(ValueError):
+        a.merge(b)
+
+
+def test_serialize_roundtrip_and_byte_pin():
+    """to_bytes is the cross-process wire format: the exact bytes of a
+    fixed small recording are pinned — any geometry or layout change
+    must show up here as a deliberate pin update."""
+    h = LogHistogram(rel_err=0.05, min_value=1e-3, max_value=10.0)
+    for v in (0.0005, 0.001, 0.004, 0.02, 0.02, 0.5, 2.0, 9.0, 50.0):
+        h.record(v)
+    blob = h.to_bytes()
+    assert blob.hex() == (
+        "474348319a9999999999a93ffca9f1d24d62503f000000000000244009000000"
+        "0000000008000000000000000100000000000000010000000100000000000000"
+        "0f00000001000000000000001f000000020000000000000040000000010000000"
+        "00000004e00000001000000000000005e00000001000000000000005f00000001"
+        "00000000000000"
+    )
+    h2 = LogHistogram.from_bytes(blob)
+    assert h2.to_bytes() == blob
+    assert h2.count == h.count
+    assert h2.percentiles() == h.percentiles()
+
+
+def test_from_bytes_rejects_corrupt_blobs():
+    h = LogHistogram()
+    h.record(1.0)
+    blob = h.to_bytes()
+    with pytest.raises(ValueError):
+        LogHistogram.from_bytes(b"XXXX" + blob[4:])
+    with pytest.raises(ValueError):
+        LogHistogram.from_bytes(blob[:-3])
+
+
+# -- coordinated omission ----------------------------------------------------
+
+
+def test_coordinated_omission_golden_stalled_consumer():
+    """THE reason this module exists: a consumer that stalls mid-run.
+
+    Closed-loop measurement (each request sent only after the previous
+    completes, latency = completion - actual send) sees the stall as ONE
+    slow sample — every request queued behind it was simply never sent,
+    so the p99 stays flat. The corrected recorder charges every order
+    from its INTENDED send time on the fixed open-loop schedule, so the
+    stall's queueing delay lands on every affected order and the p99
+    explodes. Deterministic golden: 10 s at 100/s, 1 ms service,
+    consumer frozen for 4 s in the middle."""
+    rate, service, n = 100.0, 0.001, 1000
+    stall_at, stall_len = 5.0, 4.0
+    sched = OpenLoopSchedule(rate, t0=0.0)
+    corrected = LogHistogram()
+    closed = LogHistogram()
+
+    def serve(start: float) -> float:
+        # the server is frozen over [stall_at, stall_at + stall_len)
+        if stall_at <= start < stall_at + stall_len:
+            start = stall_at + stall_len
+        return start + service
+
+    # closed loop: next send happens when the previous completes, so
+    # only ONE sample ever overlaps the frozen window
+    send = 0.0
+    for _ in range(n):
+        done = serve(send)
+        closed.record(done - send)
+        send = done  # closed loop: sender waits for completion
+
+    # open loop: arrivals on the schedule regardless of the server; the
+    # ~400 orders intended during the freeze all queue behind it
+    free_at = 0.0
+    for i in range(n):
+        t = sched.intended(i)
+        done = serve(max(t, free_at))
+        corrected.record(done - t)
+        free_at = done
+
+    closed_p99 = closed.percentile(0.99)
+    corrected_p99 = corrected.percentile(0.99)
+    # closed loop hides the stall: p99 stays at service time scale
+    assert closed_p99 < 0.1, closed_p99
+    # corrected charges the queue: p99 shows seconds of stall
+    assert corrected_p99 > 1.0, corrected_p99
+    assert corrected_p99 > 10 * closed_p99
+
+
+def test_record_corrected_backfills_missing_intervals():
+    """HDR-style correction at record time: a 1 s observation at a
+    100 ms expected interval implies 9 missed sends behind it."""
+    h = LogHistogram()
+    h.record_corrected(1.0, expected_interval=0.1)
+    assert h.count == 10
+    assert h.percentile(1.0) >= 0.9
+
+
+# -- OpenLoopSchedule --------------------------------------------------------
+
+
+def test_open_loop_schedule_arithmetic():
+    s = OpenLoopSchedule(100.0, t0=50.0)
+    assert s.intended(0) == pytest.approx(50.01)
+    assert s.intended(99) == pytest.approx(51.0)
+    assert s.batch_due(0, 10) == s.intended(9)
+    # mean accumulation wait for a batch assembled at rate r
+    assert s.accumulation_mean(11) == pytest.approx(10 / (2 * 100.0))
+    with pytest.raises(ValueError):
+        OpenLoopSchedule(0.0)
+
+
+# -- knee + attribution helpers ----------------------------------------------
+
+
+def _pt(offered, delivered, p99, rows=None):
+    return {
+        "offered_per_sec": offered,
+        "delivered_per_sec": delivered,
+        "corrected": {"p99_s": p99},
+        "attribution": {"rows": rows or []},
+    }
+
+
+def test_find_knee_on_delivered_ratio():
+    pts = [_pt(100, 99.9, 0.01), _pt(200, 199, 0.02), _pt(400, 250, 0.5)]
+    idx, reason = find_knee(pts, delivered_floor=0.98)
+    assert idx == 2
+    assert "delivered/offered" in reason
+    assert monotone_ladder(pts)
+
+
+def test_find_knee_on_p99_budget():
+    pts = [_pt(100, 100, 0.01), _pt(200, 200, 2.0), _pt(400, 400, 3.0)]
+    idx, reason = find_knee(pts, delivered_floor=0.5, p99_budget_s=1.0)
+    assert idx == 1
+    assert "p99" in reason
+
+
+def test_find_knee_none_when_healthy():
+    pts = [_pt(100, 100, 0.01), _pt(200, 199, 0.02)]
+    assert find_knee(pts) == (None, None)
+    assert not monotone_ladder([_pt(200, 1, 1), _pt(100, 1, 1)])
+
+
+def test_attribution_check_and_saturated_stage():
+    rows = [
+        {"stage": "a", "seconds_per_order": 0.06, "utilization": 0.9},
+        {"stage": "b", "seconds_per_order": 0.03, "utilization": 0.2},
+        {"stage": "wait", "seconds_per_order": 0.012, "utilization": None},
+    ]
+    chk = attribution_check(rows, e2e_mean_s=0.1, tol=0.05)
+    assert chk["within_tol"] and chk["frac_err"] == pytest.approx(0.02)
+    assert saturated_stage(rows) == "a"
+    bad = attribution_check(rows, e2e_mean_s=0.2, tol=0.05)
+    assert not bad["within_tol"]
+
+
+# -- CAPACITY singleton + payload --------------------------------------------
+
+
+def _mini_verdict():
+    rows = [
+        {"stage": "admit", "seconds_per_order": 0.05, "utilization": 0.95},
+    ]
+    return {
+        "schema": SCHEMA,
+        "mode": "single",
+        "config": {},
+        "ladder": [
+            dict(_pt(100, 100, 0.01), corrected={
+                "count": 500, "mean_s": 0.01, "p50_s": 0.008,
+                "p99_s": 0.01,
+            }),
+            dict(_pt(400, 250, 0.6, rows), corrected={
+                "count": 900, "mean_s": 0.3, "p50_s": 0.25, "p99_s": 0.6,
+            }),
+        ],
+        "knee": {
+            "found": True, "index": 1, "reason": "delivered",
+            "offered_per_sec": 400, "delivered_per_sec": 250,
+            "saturated_stage": "admit",
+        },
+        "checks": {"knee_found": True},
+        "pass": True,
+    }
+
+
+def test_capacity_singleton_disabled_by_default():
+    obs = CapacityObservatory()
+    assert not obs.enabled
+    assert obs.payload() == {"enabled": False}
+
+
+def test_capacity_install_serves_payload_and_gauges():
+    obs = CapacityObservatory()
+    reg = Registry()
+    obs.install(_mini_verdict(), registry=reg)
+    try:
+        payload = obs.payload()
+        assert payload["enabled"] is True
+        assert payload["schema"] == SCHEMA
+        assert payload["points"] == 2
+        assert payload["knee"]["saturated_stage"] == "admit"
+        text = reg.render()
+        assert "gome_capacity_points 2" in text
+        assert "gome_capacity_knee_offered_per_sec 400" in text
+        assert "gome_capacity_corrected_p99_s_at_knee 0.6" in text
+    finally:
+        obs.disable()
+    assert obs.payload() == {"enabled": False}
+
+
+def test_capacity_install_rejects_wrong_schema():
+    obs = CapacityObservatory()
+    bad = dict(_mini_verdict(), schema="nope-v0")
+    with pytest.raises(ValueError):
+        obs.install(bad, registry=Registry())
+    assert not obs.enabled
+
+
+def test_global_capacity_singleton_unarmed():
+    assert CAPACITY.payload() == {"enabled": False}
+
+
+# -- committed verdict pin ---------------------------------------------------
+
+
+def test_capacity_verdict_pin():
+    """CAPACITY_r01.json (committed, regenerated by ``scripts/capacity.py
+    --fleet``) stays green and keeps its shape: a >=5 point ladder
+    against the real 2x2 fleet, a detected knee with a named saturated
+    stage, corrected p50/p99 at every point, exactly-once at every
+    point, and the attribution sum within 5% of the measured e2e mean
+    at the knee."""
+    verdict = load_verdict(os.path.join(ROOT, "CAPACITY_r01.json"))
+    assert verdict["schema"] == SCHEMA
+    assert verdict["mode"] == "fleet"
+    assert verdict["pass"] is True
+    assert all(verdict["checks"].values()), verdict["checks"]
+    assert set(verdict["checks"]) >= {
+        "monotone_ladder", "ladder_has_5_points", "knee_found",
+        "exactly_once_all_points", "corrected_recorded_all_points",
+        "attribution_rows_nonempty", "attribution_within_tol_at_knee",
+    }
+    ladder = verdict["ladder"]
+    assert len(ladder) >= 5
+    offered = [p["offered_per_sec"] for p in ladder]
+    assert offered == sorted(offered) and len(set(offered)) == len(offered)
+    for p in ladder:
+        for key in ("p50_s", "p99_s", "count", "mean_s"):
+            assert key in p["corrected"]
+        assert p["corrected"]["count"] == p["sent"]
+        assert "p50_s" in p["closed_loop"]
+        assert p["exactly_once"]["dupes"] == 0
+        assert p["exactly_once"]["gaps"] == 0
+        assert p["attribution"]["rows"]
+    knee = verdict["knee"]
+    assert knee["found"] is True
+    assert knee["saturated_stage"]
+    assert knee["attribution_frac_err"] <= 0.05
+    kp = ladder[knee["index"]]
+    assert kp["offered_per_sec"] == knee["offered_per_sec"]
+    stages = {r["stage"] for r in kp["attribution"]["rows"]}
+    assert knee["saturated_stage"] in stages
+
+
+def test_fleet_verdict_notes_drive_rate():
+    """The regenerated FLEET_r01.json records its CHOSEN drive rate so
+    the drill's orders/sec can never again read as a capacity figure
+    (ISSUE 17 satellite)."""
+    with open(os.path.join(ROOT, "FLEET_r01.json")) as f:
+        verdict = json.load(f)
+    drive = verdict["config"]["drive"]
+    assert drive["mode"] == "open-loop"
+    assert drive["rate_per_sec"] > 0
+    assert "capacity" in drive["note"].lower() or "CAPACITY" in drive["note"]
