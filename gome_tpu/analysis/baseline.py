@@ -74,12 +74,19 @@ def fingerprint_findings(
         keyed.append(((f.rule, f.message, text), f))
     counts: dict[tuple, int] = {}
     by_id: dict[int, str] = {}
-    # occurrence index assigned in (path, line) order so within-file
-    # duplicates stay stably numbered as lines drift
+    # occurrence index assigned in (line, col) order and scoped PER FILE:
+    # within one file duplicates stay stably numbered as lines drift (and
+    # reordering identical-text duplicates only swaps interchangeable
+    # indices — the fingerprint multiset is invariant), while editing,
+    # moving, or renaming one module can never renumber ANOTHER module's
+    # duplicates. The path is still not hashed, so a moved file keeps its
+    # own fingerprints; identical keys in different files intentionally
+    # share a fingerprint — either instance matches the baseline entry.
     for key, f in sorted(keyed, key=lambda kf: (kf[1].path, kf[1].line,
                                                 kf[1].col, kf[1].rule)):
-        n = counts.get(key, 0)
-        counts[key] = n + 1
+        scope = (f.path, key)
+        n = counts.get(scope, 0)
+        counts[scope] = n + 1
         blob = "|".join((key[0], key[1], key[2], str(n)))
         by_id[id(f)] = hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
     return [(f, by_id[id(f)]) for f in findings]

@@ -27,8 +27,10 @@ import re
 
 #: Tool version (CLI --version, SARIF tool.driver.version, baseline
 #: provenance). Bump on rule-semantics changes: a fingerprint computed by
-#: one major version may legitimately churn under the next.
-TOOL_VERSION = "2.0.0"
+#: one major version may legitimately churn under the next. 2.1.0:
+#: occurrence indices are file-scoped (cross-file duplicate keys no
+#: longer renumber each other) and the GL8xx sharding family exists.
+TOOL_VERSION = "2.1.0"
 
 #: rule id -> one-line description (the catalogue; checkers register into
 #: this at import time so the CLI's --list-rules stays complete).

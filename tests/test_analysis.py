@@ -957,6 +957,59 @@ def test_fingerprint_disambiguates_identical_findings(tmp_path):
     assert len(set(fps)) == 2
 
 
+def test_fingerprint_stable_under_duplicate_line_reorder(tmp_path):
+    """Property: permuting identical-text duplicate lines within a file
+    (moving whole statement blocks around) leaves the fingerprint
+    multiset untouched — the occurrence index is an ordinal among
+    interchangeable duplicates, never a position hash."""
+    import itertools
+
+    from gome_tpu.analysis.baseline import fingerprint_findings
+    from gome_tpu.analysis.core import Finding
+
+    blocks = ["v = s()", "w = t()", "v = s()", "u = r()", "v = s()"]
+    a = tmp_path / "a.py"
+
+    def fps_for(order):
+        lines = [blocks[i] for i in order]
+        a.write_text("\n".join(lines) + "\n")
+        fs = [Finding("GL501", str(a), ln + 1, 0, "m")
+              for ln, text in enumerate(lines) if text == "v = s()"]
+        return sorted(fp for _, fp in fingerprint_findings(fs))
+
+    base = fps_for(range(5))
+    assert len(set(base)) == 3  # three duplicates, three distinct indices
+    for order in itertools.permutations(range(5)):
+        assert fps_for(order) == base, order
+
+
+def test_fingerprint_occurrence_index_is_file_scoped(tmp_path):
+    """Renaming one module must not renumber another module's duplicate-
+    key findings ('moving a module keeps its findings baselined'). The
+    pre-2.1.0 counter spanned files in path-sort order, so a rename
+    upstream churned fingerprints in untouched files."""
+    from gome_tpu.analysis.baseline import fingerprint_findings
+    from gome_tpu.analysis.core import Finding
+
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("v = s()\n")
+    b.write_text("v = s()\n")
+    fb = Finding("GL501", str(b), 1, 0, "m")
+    [_, (_, fp_b)] = fingerprint_findings(
+        [Finding("GL501", str(a), 1, 0, "m"), fb])
+
+    # rename a.py so it sorts AFTER b.py: b's fingerprint must not move
+    z = tmp_path / "z.py"
+    a.rename(z)
+    [(_, fp_b2), (_, fp_z)] = fingerprint_findings(
+        [fb, Finding("GL501", str(z), 1, 0, "m")])
+    assert fp_b2 == fp_b
+    # identical cross-file keys share one baseline entry by design:
+    # either instance matches it, and neither can churn the other
+    assert fp_z == fp_b2
+
+
 def test_baseline_roundtrip_and_partition(tmp_path):
     from gome_tpu.analysis.baseline import (
         fingerprint_findings, load_baseline, partition, save_baseline,
