@@ -166,6 +166,7 @@ def _ensure_checkers_loaded() -> None:
         donation,
         locks,
         recompile,
+        sharding,
         threads,
         trace_safety,
         transfers,

@@ -1,0 +1,939 @@
+"""GL8xx sharding & partition-consistency: specs, padding, placement.
+
+The two numbers the ROADMAP says to beat — MULTICHIP_r06 (adding devices
+LOSES throughput: every shard pads to the global max row block, skew
+3.64) and FLEET_r01 (3.7x partition imbalance from naive hashing) — are
+both sharding/partitioning diseases. GL1xx–GL7xx audit tracer leaks, the
+int32 envelope, recompiles, locks, transfers, donation, and thread
+escapes; nothing audited pjit/shard_map specs, spec flow between
+entries, or partition-policy discipline. This family does:
+
+  GL801  out-spec→in-spec mismatch between chained sharded entries: the
+         result of one jit/shard_map entry flows into another whose
+         declared spec for that position differs — XLA inserts a
+         reshard on EVERY call (the pjit guidance: "make sure the
+         partitioning matches").
+  GL802  global-max padding: a per-shard row block / pad width derived
+         from a reduction over ALL shards' live counts and multiplied
+         by the mesh size — every shard pays the hottest shard's rows
+         (the exact `_grid_geometry` disease behind MULTICHIP_r06).
+  GL803  ad-hoc partition hashing: a symbol→partition/lane mapping via
+         a private hash (`crc32(s) % n`, `hash(s) % n`, a local fnv)
+         instead of the blessed placement helpers
+         (`gome_tpu.fleet.router.partition_of` /
+         `gome_tpu.parallel.router.ShardRouter`) — two hash policies in
+         one fleet double-route symbols (FLEET_r01's imbalance was a
+         private crc32 before PR 14).
+  GL804  donation across a sharding boundary: a donated argument whose
+         declared sharding matches no output sharding of the same entry
+         — the donated buffer cannot be reused in place across a spec
+         boundary, so XLA pays a reshard/copy AND frees the input
+         (extends the GL6xx audit with spec awareness).
+  GL805  host materialization between shard-resident frames: a device-
+         resident value is fetched to host (`jax.device_get` / numpy
+         coercion) and then re-dispatched to the mesh (`shard_batch` /
+         `jax.device_put` / a sharded entry) — a device→host→device
+         round trip; keep it resident and reshard on device.
+  GL806  sharding manifest drift: the per-entry manifest extracted from
+         the shared engine trace + the mesh module's declared specs
+         differs from the committed `shard_manifest.json` — spec
+         changes must be reviewed (``--update-manifest``), never
+         silently absorbed.
+
+Division of labor with the traced memo (one engine trace per run, shared
+with GL2xx/GL6xx — envelope.traced_entries): the manifest extractor
+derives each engine entry's in/out avals and donation from that memo and
+each mesh entry's axes/specs/donation from `parallel/mesh.py`'s AST;
+GL806 ratchets the result. GL801/GL804 are AST spec-flow over the same
+declared specs (canonicalized with local-alias substitution, so
+``spec = P(SYM_AXIS)`` and ``P('sym')`` compare equal); GL802/GL803 are
+pure AST; GL805 rides the project call graph (jit detection) with a
+lexical device/fetch taint per function.
+
+Documented limits (a linter, not a partitioner): spec comparison is
+textual after alias substitution — two spellings of one sharding that
+alias through helpers this pass cannot see compare unequal (and vice
+versa never: equal text is equal spec); GL805's taint is per-function
+(a fetch returned from a helper and re-dispatched by its caller is
+missed); GL801 tracks positional arguments bound to plain names.
+"""
+
+from __future__ import annotations
+
+import ast
+import copy
+import json
+import os
+import re
+
+from . import callgraph
+from .core import (
+    TOOL_VERSION,
+    Finding,
+    register_checker,
+    register_project_checker,
+    register_rules,
+)
+from .trace_safety import _const_int_tuple, _dotted, _is_jit_expr
+
+register_rules({
+    "GL801": "out-spec of a sharded entry feeds an entry declaring a "
+             "different in-spec (reshard on every call)",
+    "GL802": "per-shard row block derived from a reduction over ALL "
+             "shards (global-max padding, the MULTICHIP skew tax)",
+    "GL803": "ad-hoc symbol->partition hashing outside the blessed "
+             "placement helpers (fleet.router.partition_of)",
+    "GL804": "donated argument's sharding matches no output sharding "
+             "(donation across a spec boundary is a copy, not a reuse)",
+    "GL805": "host materialization of device-resident state re-"
+             "dispatched to the mesh (device->host->device round trip)",
+    "GL806": "sharding manifest drift — spec surface changed without "
+             "--update-manifest",
+})
+
+#: Committed manifest location, relative to the repo root (mirrors
+#: baseline.DEFAULT_BASELINE).
+DEFAULT_MANIFEST = os.path.join("gome_tpu", "analysis",
+                                "shard_manifest.json")
+
+#: Modules allowed to implement hash->partition maps: the blessed
+#: placement helpers everything else must route through.
+_BLESSED_PARTITION_MODULES = ("fleet/router.py", "parallel/router.py")
+
+_HASH_LEAVES = {"crc32", "adler32", "md5", "sha1", "sha256", "blake2b",
+                "fnv1a", "hash"}
+
+_PLACEMENT_LEAVES = {"shard_batch", "device_put"}
+
+
+# --- canonical spec text (alias-substituted unparse) ----------------------
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+def _own_nodes(scope: ast.AST, types) -> list[ast.AST]:
+    """Nodes of the given types belonging to `scope` itself — recursing
+    through control flow but NOT into nested defs/lambdas/classes, which
+    are their own scopes."""
+    out: list[ast.AST] = []
+
+    def walk(node) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPE_NODES):
+                continue
+            if isinstance(child, types):
+                out.append(child)
+            walk(child)
+
+    walk(scope)
+    return out
+
+
+def _direct_defs(scope: ast.AST) -> list[ast.AST]:
+    """Defs whose nearest enclosing scope is `scope`."""
+    out: list[ast.AST] = []
+
+    def walk(node) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(child)
+            elif not isinstance(child, ast.Lambda):
+                walk(child)
+
+    walk(scope)
+    return out
+
+
+def _simple_assigns(scope: ast.AST) -> dict[str, ast.expr]:
+    """Single-Name-target assignments in `scope` (nested scopes
+    excluded). Self-referential assigns are skipped — _canon's bounded
+    fixpoint must terminate."""
+    env: dict[str, ast.expr] = {}
+    for node in _own_nodes(scope, ast.Assign):
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if not any(isinstance(n, ast.Name) and n.id == name
+                       for n in ast.walk(node.value)):
+                env[name] = node.value
+    return env
+
+
+def _canon(node: ast.expr, env: dict[str, ast.expr]) -> str:
+    """Canonical text of a spec expression with simple Name aliases
+    substituted (bounded fixpoint): `P(SYM_AXIS)` with SYM_AXIS='sym'
+    renders as "P('sym')"."""
+    node = copy.deepcopy(node)
+    for _ in range(5):
+        changed = [False]
+
+        class _Sub(ast.NodeTransformer):
+            def visit_Name(self, n):  # noqa: N805 - ast API
+                if isinstance(n.ctx, ast.Load) and n.id in env:
+                    changed[0] = True
+                    return copy.deepcopy(env[n.id])
+                return n
+
+        node = _Sub().visit(node)
+        if not changed[0]:
+            break
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - malformed synthetic trees
+        return ""
+
+
+def _kw(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _spec_tuple(node: ast.expr | None,
+                env: dict[str, ast.expr]) -> tuple[str, ...] | None:
+    """A specs keyword value -> per-position canonical strings (a non-
+    tuple spec is a 1-tuple); None when the keyword is absent."""
+    if node is None:
+        return None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(_canon(el, env) for el in node.elts)
+    return (_canon(node, env),)
+
+
+def _sharded_call_specs(call: ast.Call, env: dict[str, ast.expr]):
+    """(in_specs, out_specs, donate_argnums) of a spec-carrying
+    construction — ``jax.jit(f, in_shardings=..., out_shardings=...)``
+    or ``shard_map(f, in_specs=..., out_specs=...)`` — else None."""
+    if _is_jit_expr(call.func):
+        ins = _kw(call, "in_shardings")
+        outs = _kw(call, "out_shardings")
+        if ins is None and outs is None:
+            return None
+        return (_spec_tuple(ins, env), _spec_tuple(outs, env),
+                _const_int_tuple(_kw(call, "donate_argnums")))
+    leaf = (_dotted(call.func) or "").rsplit(".", 1)[-1]
+    if leaf == "shard_map":
+        ins = _kw(call, "in_specs")
+        outs = _kw(call, "out_specs")
+        if ins is None and outs is None:
+            return None
+        return (_spec_tuple(ins, env), _spec_tuple(outs, env), ())
+    return None
+
+
+def _scopes(root: ast.AST, env: dict[str, ast.expr]):
+    """Yield (scope_node, accumulated_env) depth-first: module, then
+    every def with its enclosing scopes' aliases visible."""
+    own = dict(env)
+    own.update(_simple_assigns(root))
+    yield root, own
+    for child in _direct_defs(root):
+        yield from _scopes(child, own)
+
+
+# --- the project-wide spec registry (GL801/GL805 consumers) ---------------
+
+class _Entry:
+    """One declared sharded entry: a name callers can invoke whose
+    result/arguments carry declared specs."""
+
+    __slots__ = ("name", "module", "module_level", "in_specs", "out_specs",
+                 "donate", "line", "factory")
+
+    def __init__(self, name, module, module_level, in_specs, out_specs,
+                 donate, line, factory):
+        self.name = name
+        self.module = module
+        self.module_level = module_level
+        self.in_specs = in_specs
+        self.out_specs = out_specs
+        self.donate = donate
+        self.line = line
+        #: True when `name` is a function RETURNING the entry (the
+        #: `sharded_batch_step` idiom): calling it constructs a stepper
+        #: (aliased by assignment), it does not itself dispatch.
+        self.factory = factory
+
+
+class _SpecRegistry:
+    """name -> declared sharded entries, scoped like GL603's donation
+    registry: module-level definitions are importable and match project-
+    wide, local ones match only their own module. Two forms register:
+
+      * ``name = jax.jit(f, in_shardings=..., ...)`` (and the shard_map
+        analogue) — calls of ``name`` are the sharded dispatch;
+      * ``def factory(...): return jax.jit(f, in_shardings=..., ...)``
+        — a variable assigned from ``factory(...)`` carries the
+        returned entry's specs (the `sharded_batch_step` idiom).
+    """
+
+    def __init__(self, project):
+        self.entries: dict[str, list[_Entry]] = {}
+        for module in project.modules:
+            for scope, env in _scopes(module.tree, {}):
+                is_module = isinstance(scope, ast.Module)
+                for node in _own_nodes(scope, (ast.Assign, ast.Return)):
+                    if isinstance(node, ast.Assign) \
+                            and isinstance(node.value, ast.Call):
+                        specs = _sharded_call_specs(node.value, env)
+                        if specs is None:
+                            continue
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self._add(t.id, module, is_module,
+                                          specs, node.lineno, False)
+                    elif isinstance(node, ast.Return) and not is_module \
+                            and isinstance(node.value, ast.Call):
+                        specs = _sharded_call_specs(node.value, env)
+                        if specs is not None:
+                            self._add(scope.name, module,
+                                      scope in module.tree.body,
+                                      specs, node.lineno, True)
+
+    def _add(self, name, module, module_level, specs, line,
+             factory) -> None:
+        ins, outs, donate = specs
+        self.entries.setdefault(name, []).append(
+            _Entry(name, module, module_level, ins, outs, donate, line,
+                   factory)
+        )
+
+    def lookup(self, name: str, module) -> _Entry | None:
+        for e in self.entries.get(name, ()):
+            if e.module is module or e.module_level:
+                return e
+        return None
+
+
+# --- GL801: chained-entry spec flow (project checker) ---------------------
+
+class _SpecFlowScan(ast.NodeVisitor):
+    """One function body: track variables produced by sharded entries
+    (with the out-spec of their position) and flag calls that feed them
+    into an entry declaring a different in-spec."""
+
+    def __init__(self, registry: _SpecRegistry, fn: callgraph.FuncNode):
+        self.reg = registry
+        self.fn = fn
+        #: var -> _Entry it was built from (factory-call aliasing)
+        self.aliases: dict[str, _Entry] = {}
+        #: var -> (entry, out position) of the producing call
+        self.produced: dict[str, tuple[_Entry, int]] = {}
+        self.findings: list[Finding] = []
+
+    def _dispatch_entry(self, func: ast.expr) -> _Entry | None:
+        """The sharded entry a call of `func` DISPATCHES: an alias built
+        from a factory, or a directly-registered jit name. Calling a
+        factory by name only constructs — it never dispatches."""
+        if isinstance(func, ast.Name):
+            if func.id in self.aliases:
+                return self.aliases[func.id]
+            e = self.reg.lookup(func.id, self.fn.module)
+            if e is not None and not e.factory:
+                return e
+        return None
+
+    def visit_FunctionDef(self, node):
+        if node is not self.fn.node:
+            return
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        if node is self.fn.node:
+            self.visit(node.body)
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        value = node.value
+        if not isinstance(value, ast.Call):
+            self._kill(node.targets)
+            return
+        entry = self._dispatch_entry(value.func)
+        if entry is not None and entry.out_specs:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._kill([t])
+                    if len(entry.out_specs) == 1:
+                        self.produced[t.id] = (entry, 0)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for i, el in enumerate(t.elts):
+                        if isinstance(el, ast.Name) \
+                                and i < len(entry.out_specs):
+                            self._kill([el])
+                            self.produced[el.id] = (entry, i)
+            return
+        # factory aliasing: stepper = sharded_dense_step(...)
+        if isinstance(value.func, ast.Name):
+            fac = self.reg.lookup(value.func.id, self.fn.module)
+            if fac is not None and fac.factory:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.aliases[t.id] = fac
+                return
+        self._kill(node.targets)
+
+    def _kill(self, targets) -> None:
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    self.produced.pop(n.id, None)
+                    self.aliases.pop(n.id, None)
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        entry = self._dispatch_entry(node.func)
+        if entry is None or not entry.in_specs:
+            return
+        called = node.func.id if isinstance(node.func, ast.Name) \
+            else entry.name
+        for i, arg in enumerate(node.args):
+            if i >= len(entry.in_specs):
+                break
+            want = entry.in_specs[i]
+            got = None
+            src = None
+            if isinstance(arg, ast.Name) and arg.id in self.produced:
+                prod, pos = self.produced[arg.id]
+                if prod.out_specs and pos < len(prod.out_specs):
+                    got = prod.out_specs[pos]
+                    src = prod.name
+            elif isinstance(arg, ast.Call):
+                prod = self._dispatch_entry(arg.func)
+                if prod is not None and prod.out_specs \
+                        and len(prod.out_specs) == 1:
+                    got = prod.out_specs[0]
+                    src = prod.name
+            if got is not None and want and got != want:
+                self.findings.append(Finding(
+                    "GL801", self.fn.module.path, node.lineno,
+                    node.col_offset,
+                    f"argument #{i} of {called}() carries {src}'s "
+                    f"out-spec {got} but the entry declares in-spec "
+                    f"{want} — XLA resharding on every call; align the "
+                    f"specs [in {self.fn.qualname}]",
+                ))
+
+    def run(self) -> list[Finding]:
+        node = self.fn.node
+        if isinstance(node, ast.Lambda):
+            self.visit(node.body)
+        else:
+            for stmt in node.body:
+                self.visit(stmt)
+        return self.findings
+
+
+# --- GL805: fetch-then-redispatch (project checker) -----------------------
+
+class _RoundTripScan(ast.NodeVisitor):
+    """One function body: lexical device/fetch taint. dev = values from
+    device_put/shard_batch/jnp.*/jitted project calls; fetched = host
+    materializations (device_get / np coercion) OF dev values; flag a
+    fetched value handed to a mesh placement call or sharded entry."""
+
+    def __init__(self, checker: "_ProjectChecker", fn: callgraph.FuncNode):
+        self.c = checker
+        self.fn = fn
+        self.dev: set[str] = set()
+        self.fetched: set[str] = set()
+        self.dispatch: set[str] = set()  # aliases of factory entries
+        self.findings: list[Finding] = []
+
+    # -- expression classification ----------------------------------------
+    def _mentions(self, node: ast.AST, names: set[str]) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in names
+                   for n in ast.walk(node))
+
+    def _is_device(self, node: ast.AST) -> bool:
+        if self._mentions(node, self.dev):
+            return True
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            d = _dotted(n.func) or ""
+            leaf = d.rsplit(".", 1)[-1]
+            root = d.split(".", 1)[0]
+            if leaf in ("device_put", "shard_batch") or root == "jnp":
+                return True
+            if isinstance(n.func, ast.Name):
+                if n.func.id in self.dispatch:
+                    return True  # a sharded entry's result is resident
+                for target in self.c.graph.resolve_name(n.func.id,
+                                                        self.fn):
+                    if target.jitted:
+                        return True
+        return False
+
+    def _fetch_of_device(self, node: ast.AST) -> str | None:
+        """'device_get'/'np.asarray' when `node` is a host
+        materialization of a device value, else None."""
+        if not isinstance(node, ast.Call) or not node.args:
+            return None
+        d = _dotted(node.func) or ""
+        leaf = d.rsplit(".", 1)[-1]
+        root = d.split(".", 1)[0]
+        is_fetch = leaf == "device_get" or (
+            root in ("np", "numpy") and leaf in ("asarray", "array"))
+        if is_fetch and self._is_device(node.args[0]):
+            return d
+        return None
+
+    def _is_fetched(self, node: ast.AST) -> bool:
+        return self._mentions(node, self.fetched) \
+            or self._fetch_of_device(node) is not None
+
+    # -- statements --------------------------------------------------------
+    def _assign(self, targets, value) -> None:
+        fetched = self._is_fetched(value)
+        dev = not fetched and self._is_device(value)
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    self.fetched.discard(n.id)
+                    self.dev.discard(n.id)
+                    if fetched:
+                        self.fetched.add(n.id)
+                    elif dev:
+                        self.dev.add(n.id)
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        value = node.value
+        if isinstance(value, ast.Call) and isinstance(value.func, ast.Name):
+            fac = self.c.registry.lookup(value.func.id, self.fn.module)
+            if fac is not None and fac.factory:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.dispatch.add(t.id)
+                return
+        self._assign(node.targets, value)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self.generic_visit(node)
+            self._assign([node.target], node.value)
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        d = _dotted(node.func) or ""
+        leaf = d.rsplit(".", 1)[-1]
+        is_placement = leaf in _PLACEMENT_LEAVES
+        if not is_placement and isinstance(node.func, ast.Name):
+            if node.func.id in self.dispatch:
+                is_placement = True
+            else:
+                e = self.c.registry.lookup(node.func.id, self.fn.module)
+                is_placement = e is not None and not e.factory
+        if not is_placement:
+            return
+        for arg in node.args:
+            how = self._fetch_of_device(arg)
+            if how is None and self._mentions(arg, self.fetched):
+                how = "a host copy"
+            if how is not None:
+                self.findings.append(Finding(
+                    "GL805", self.fn.module.path, node.lineno,
+                    node.col_offset,
+                    f"{leaf}() re-dispatches a value materialized to "
+                    f"host via {how} — device->host->device round trip; "
+                    "keep it device-resident (reshard/device_put the "
+                    "original, or shard the host source before upload) "
+                    f"[in {self.fn.qualname}]",
+                ))
+
+    def visit_FunctionDef(self, node):
+        if node is self.fn.node:
+            for stmt in node.body:
+                self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        if node is self.fn.node:
+            self.visit(node.body)
+
+    def run(self) -> list[Finding]:
+        node = self.fn.node
+        if isinstance(node, ast.Lambda):
+            self.visit(node.body)
+        else:
+            for stmt in node.body:
+                self.visit(stmt)
+        return self.findings
+
+
+class _ProjectChecker:
+    def __init__(self, project):
+        self.graph = callgraph.build(project)
+        self.registry = _SpecRegistry(project)
+
+    def run(self) -> list[Finding]:
+        findings: list[Finding] = []
+        for fn in self.graph.funcs:
+            if fn.jitted:
+                continue  # inside the graph, specs are XLA's problem
+            if self.registry.entries:
+                findings.extend(_SpecFlowScan(self.registry, fn).run())
+            findings.extend(_RoundTripScan(self, fn).run())
+        return findings
+
+
+def check_spec_flow(project) -> list[Finding]:
+    return _ProjectChecker(project).run()
+
+
+register_project_checker("GL8", check_spec_flow)
+
+
+# --- GL802/GL803/GL804: module checkers -----------------------------------
+
+def _is_mesh_size(node: ast.expr) -> bool:
+    """`<something>.mesh.size` / `mesh.size` — the shard count."""
+    if isinstance(node, ast.Attribute) and node.attr == "size":
+        d = _dotted(node.value) or ""
+        return d.split(".")[-1].endswith("mesh")
+    return False
+
+
+class _GeometryScan(ast.NodeVisitor):
+    """GL802 within one function: a variable reduced over ALL shards'
+    counts (bincount -> .max()/np.max) that is later multiplied by the
+    mesh size is the global-max padding idiom. One finding per derived
+    variable, anchored at its derivation."""
+
+    def __init__(self, module, fn_node):
+        self.module = module
+        self.fn = fn_node
+        self.counts: set[str] = set()     # np.bincount products
+        self.gmax: dict[str, int] = {}    # global-max vars -> def line
+        self.mesh: set[str] = set()       # mesh-size vars
+        self.findings: list[Finding] = []
+        self.reported: set[str] = set()
+
+    def _has_global_reduction(self, node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            # counts.max() — argless full reduction of a shard histogram
+            if isinstance(n.func, ast.Attribute) and n.func.attr == "max" \
+                    and not n.args and not n.keywords:
+                recv = _dotted(n.func.value) or ""
+                if recv.split(".")[-1] in self.counts:
+                    return True
+            d = _dotted(n.func) or ""
+            if d in ("np.max", "numpy.max") and n.args:
+                first = _dotted(n.args[0]) or ""
+                if first.split(".")[-1] in self.counts:
+                    return True
+        return False
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not names:
+            return
+        value = node.value
+        d = _dotted(value.func) if isinstance(value, ast.Call) else None
+        if d and d.rsplit(".", 1)[-1] == "bincount":
+            self.counts.update(names)
+            return
+        if _is_mesh_size(value):
+            self.mesh.update(names)
+            return
+        if self._has_global_reduction(value) \
+                or any(isinstance(n, ast.Name) and n.id in self.gmax
+                       for n in ast.walk(value)):
+            for name in names:
+                self.gmax.setdefault(name, node.lineno)
+
+    def visit_BinOp(self, node):
+        self.generic_visit(node)
+        if not isinstance(node.op, ast.Mult):
+            return
+        sides = (node.left, node.right)
+        mesh_side = any(
+            (isinstance(s, ast.Name) and s.id in self.mesh)
+            or _is_mesh_size(s) for s in sides)
+        gm = next((s.id for s in sides if isinstance(s, ast.Name)
+                   and s.id in self.gmax), None)
+        if mesh_side and gm is not None and gm not in self.reported:
+            self.reported.add(gm)
+            self.findings.append(Finding(
+                "GL802", self.module.path, self.gmax[gm], 0,
+                f"per-shard row block {gm!r} is a reduction over ALL "
+                f"shards' live counts and is multiplied by the mesh size "
+                f"(line {node.lineno}) — every shard pads to the hottest "
+                "shard's rows (the MULTICHIP_r06 skew tax); derive the "
+                "block per shard",
+            ))
+
+    def visit_FunctionDef(self, node):
+        if node is self.fn:
+            for stmt in node.body:
+                self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def run(self) -> list[Finding]:
+        for stmt in self.fn.body:
+            self.visit(stmt)
+        return self.findings
+
+
+def _check_geometry(module) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_GeometryScan(module, node).run())
+    return findings
+
+
+def _check_partition_hash(module) -> list[Finding]:
+    """GL803: `hashlike(sym) % n` outside the blessed router modules."""
+    path = module.path.replace(os.sep, "/")
+    if path.endswith(_BLESSED_PARTITION_MODULES):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Mod)):
+            continue
+        left = node.left
+        if isinstance(left, ast.Call):
+            leaf = (_dotted(left.func) or "").rsplit(".", 1)[-1]
+            if leaf in _HASH_LEAVES:
+                findings.append(Finding(
+                    "GL803", module.path, node.lineno, node.col_offset,
+                    f"ad-hoc {leaf}()-modulo partition map — route "
+                    "symbol placement through gome_tpu.fleet.router."
+                    "partition_of (one policy tree-wide; FLEET_r01's "
+                    "3.7x imbalance came from a private hash)",
+                ))
+    return findings
+
+
+def _check_donation_specs(module) -> list[Finding]:
+    """GL804: a jit construction that both donates and pins shardings,
+    where a donated argument's in-sharding matches no out-sharding."""
+    findings: list[Finding] = []
+    for scope, env in _scopes(module.tree, {}):
+        for call in _own_nodes(scope, ast.Call):
+            if not _is_jit_expr(call.func):
+                continue
+            specs = _sharded_call_specs(call, env)
+            if specs is None:
+                continue
+            ins, outs, donate = specs
+            if not donate or ins is None or outs is None:
+                continue
+            for i in donate:
+                if i >= len(ins):
+                    continue
+                if ins[i] not in outs:
+                    findings.append(Finding(
+                        "GL804", module.path, call.lineno,
+                        call.col_offset,
+                        f"donated argument #{i} is declared {ins[i]} "
+                        "but no output declares that sharding — across "
+                        "a spec boundary XLA copies instead of reusing "
+                        "the buffer (and still frees the input); align "
+                        "the specs or drop the donation",
+                    ))
+    return findings
+
+
+def _check_module(module) -> list[Finding]:
+    out = _check_geometry(module)
+    out.extend(_check_partition_hash(module))
+    out.extend(_check_donation_specs(module))
+    return out
+
+
+register_checker("GL8", _check_module)
+
+
+# --- the sharding manifest (extract / save / drift ratchet) ---------------
+
+#: Stable traced contexts recorded in the manifest, with the shard-
+#: locality classification of each entry's data. The best-effort pallas
+#: interpret record is deliberately excluded: its presence varies by
+#: environment, and a manifest must diff clean across machines.
+_TRACED_MANIFEST_CONTEXTS = (
+    ("engine/step.py:step_impl", "lane_local"),
+    ("engine/batch.py:batch_step", "sym_sharded"),
+    ("engine/batch.py:dense_batch_step", "sym_sharded"),
+    ("engine/batch.py:lane_scan", "lane_local"),
+    ("engine/frames.py:compact_accum", "replicated"),
+    ("engine/frames.py:_scatter_grid_fn", "replicated"),
+    ("sim/flow.py:gen_ops", "sym_sharded"),
+)
+
+
+def _aval_str(aval) -> str:
+    shape = "x".join(str(int(d)) for d in aval.shape)
+    return f"{shape or 'scalar'}:{aval.dtype}"
+
+
+def _mesh_ast_entries(root: str) -> dict:
+    """parallel/mesh.py's declared mesh entries: every function whose
+    return is a jit with pinned shardings, plus the inner shard_map
+    specs and axis names parsed from the canonicalized spec text."""
+    rel = os.path.join("parallel", "mesh.py")
+    path = os.path.join(root, rel)
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    # Spec-carrying calls are canonicalized in the scope they appear in
+    # (an inner shard_map's `spec = P(SYM_AXIS)` alias lives in the
+    # nested stepper, not the factory), then attributed to the TOP-LEVEL
+    # function — the name callers import.
+    jit_specs: dict[str, tuple] = {}
+    sm_specs: dict[str, tuple] = {}
+
+    def walk(scope, env, top) -> None:
+        env = dict(env)
+        env.update(_simple_assigns(scope))
+        if top is not None:
+            for call in _own_nodes(scope, ast.Call):
+                specs = _sharded_call_specs(call, env)
+                if specs is None:
+                    continue
+                if _is_jit_expr(call.func):
+                    jit_specs.setdefault(top, specs)
+                else:
+                    sm_specs.setdefault(top, specs[:2])
+        for child in _direct_defs(scope):
+            walk(child, env, top or child.name)
+
+    walk(tree, {}, None)
+    entries: dict[str, dict] = {}
+    for name, (ins, outs, donate) in jit_specs.items():
+        sm_ins, sm_outs = sm_specs.get(name, (None, None))
+        spec_text = " ".join(
+            s for block in (ins, outs, sm_ins, sm_outs) if block
+            for s in block
+        )
+        axes = sorted(set(re.findall(r"'([A-Za-z_]\w*)'", spec_text)))
+        entries[f"parallel/mesh.py:{name}"] = dict(
+            kind="mesh_entry",
+            mesh_axes=axes,
+            in_shardings=list(ins or ()),
+            out_shardings=list(outs or ()),
+            shard_map_in_specs=list(sm_ins or ()),
+            shard_map_out_specs=list(sm_outs or ()),
+            donate_argnums=list(donate),
+            classification="shard_local" if axes else "replicated",
+        )
+    return entries
+
+
+def extract_manifest(dtype: str = "int32") -> dict:
+    """The per-entry sharding manifest: engine entries from the SHARED
+    trace memo (envelope.traced_entries — one trace per run, same memo
+    GL2xx/GL6xx walk) + mesh entries from parallel/mesh.py's AST.
+    Deterministic for a given tree: no line numbers, no timestamps."""
+    from .donation import _ENGINE_WRAPPERS, wrapper_jit_spec
+    from .envelope import traced_entries
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    records = {r["context"]: r for r in traced_entries(dtype)}
+    tree_cache: dict[str, ast.AST] = {}
+    entries: dict[str, dict] = {}
+    for context, classification in _TRACED_MANIFEST_CONTEXTS:
+        rec = records.get(context)
+        if rec is None:
+            continue
+        closed = rec["closed"]
+        donation: dict[str, list[int]] = {}
+        for rel, wrapper, ctx, _arg_map, _params in _ENGINE_WRAPPERS:
+            if ctx != context:
+                continue
+            if rel not in tree_cache:
+                with open(os.path.join(root, rel), encoding="utf-8") as fh:
+                    tree_cache[rel] = ast.parse(fh.read())
+            spec = wrapper_jit_spec(tree_cache[rel], wrapper)
+            if spec is not None:
+                donation[wrapper] = sorted(spec[1])
+        entries[context] = dict(
+            kind="engine_entry",
+            mesh_axes=[],
+            in_avals=[_aval_str(v.aval) for v in closed.jaxpr.invars
+                      if hasattr(getattr(v, "aval", None), "shape")],
+            out_avals=[_aval_str(v.aval) for v in closed.jaxpr.outvars
+                       if hasattr(getattr(v, "aval", None), "shape")],
+            donation=donation,
+            classification=classification,
+        )
+    entries.update(_mesh_ast_entries(root))
+    return dict(
+        version=1,
+        tool=f"gomelint {TOOL_VERSION}",
+        dtype=dtype,
+        note="Per-entry sharding surface (mesh axes, specs, donation, "
+             "shard-locality), extracted from the shared engine trace + "
+             "parallel/mesh.py. CI fails on drift (GL806); regenerate "
+             "with scripts/gomelint.py --jaxpr --update-manifest and "
+             "review the diff like any spec change.",
+        entries=entries,
+    )
+
+
+def save_manifest(path: str, manifest: dict) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def load_manifest(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except OSError:
+        return None
+
+
+def check_sharding_manifest(dtype: str = "int32",
+                            path: str | None = None) -> list[Finding]:
+    """GL806 drift ratchet: the extracted manifest must equal the
+    committed one entry-for-entry. Findings anchor on the manifest file
+    so the fix-it action (--update-manifest + review) is unambiguous."""
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    if path is None:
+        path = os.path.join(root, DEFAULT_MANIFEST)
+    rel = os.path.relpath(path, root) if os.path.isabs(path) else path
+    committed = load_manifest(path)
+    if committed is None:
+        return [Finding(
+            "GL806", rel, 1, 0,
+            "no committed sharding manifest — run scripts/gomelint.py "
+            "--jaxpr --update-manifest and commit the file",
+        )]
+    if committed.get("dtype") != dtype:
+        return []  # the manifest pins the CI dtype; other audits skip
+    current = extract_manifest(dtype)
+    findings: list[Finding] = []
+    cur, com = current["entries"], committed.get("entries", {})
+    for ctx in sorted(set(cur) | set(com)):
+        if ctx not in com:
+            what = "entry is new (not in the committed manifest)"
+        elif ctx not in cur:
+            what = ("entry vanished from the trace/AST but is still in "
+                    "the manifest")
+        elif cur[ctx] != com[ctx]:
+            changed = sorted(
+                k for k in set(cur[ctx]) | set(com[ctx])
+                if cur[ctx].get(k) != com[ctx].get(k)
+            )
+            what = f"{', '.join(changed)} changed vs the committed manifest"
+        else:
+            continue
+        findings.append(Finding(
+            "GL806", rel, 1, 0,
+            f"{ctx}: {what} — review the spec change and regenerate "
+            "with --update-manifest",
+        ))
+    return findings

@@ -938,7 +938,11 @@ class BatchEngine:
             local = self.n_slots // d
             shard = live // local  # live is sorted (np.unique upstream)
             counts = np.bincount(shard, minlength=d)
-            r_s = max(8, bucket(int(counts.max())), floor)
+            # Uniform R_s = global max is structural for now: shard_map's
+            # even split hands every chip the same [R_s, T] block, so one
+            # hot shard pads ALL shards (MULTICHIP_r06 skew 3.64).
+            # Per-shard geometry is ROADMAP item 2's refactor.
+            r_s = max(8, bucket(int(counts.max())), floor)  # gomelint: disable=GL802 — owning workstream: ROADMAP item 2 (per-shard geometry)
             if r_s * d >= self.n_slots:
                 return False, self.n_slots, None, None
             if first:

@@ -17,10 +17,15 @@ findings JSON and `--sarif FILE` writes SARIF 2.1.0 (both uploaded by the
 CI analysis job; SARIF renders as code-review annotations).
 
 The AST rules are dependency-free; `--jaxpr` imports jax and traces the
-engine's device entry points ONCE (a few seconds on CPU), feeding both
-the GL2xx dtype-envelope audit and the GL6xx buffer-donation audit from
-the same traced jaxprs — see gome_tpu/analysis/envelope.py,
-gome_tpu/analysis/donation.py, and ARCHITECTURE.md "Static analysis".
+engine's device entry points ONCE (a few seconds on CPU), feeding the
+GL2xx dtype-envelope audit, the GL6xx buffer-donation audit, AND the
+GL8xx sharding-manifest ratchet (GL806) from the same traced jaxprs —
+see gome_tpu/analysis/envelope.py, gome_tpu/analysis/donation.py,
+gome_tpu/analysis/sharding.py, and ARCHITECTURE.md "Static analysis".
+`--update-manifest` (with --jaxpr) rewrites the committed sharding
+manifest (gome_tpu/analysis/shard_manifest.json, override with
+--manifest) to the current spec surface; like --update-baseline, the
+diff is reviewed, not silently absorbed.
 CI's dedicated race job re-runs `--select GL7` (the thread-escape
 family, AST-only, so thread-discipline regressions are named by rule)
 before the scripts/race_drill.py lockset drill.
@@ -48,6 +53,7 @@ from gome_tpu.analysis.core import (  # noqa: E402
     TOOL_VERSION,
     _ensure_checkers_loaded,
 )
+from gome_tpu.analysis.sharding import DEFAULT_MANIFEST  # noqa: E402
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -74,6 +80,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline to the current findings "
                          "and exit 0 (review the diff!)")
+    ap.add_argument("--manifest",
+                    default=os.path.join(ROOT, DEFAULT_MANIFEST),
+                    help="sharding manifest for the GL806 drift ratchet "
+                         f"(default: {DEFAULT_MANIFEST})")
+    ap.add_argument("--update-manifest", action="store_true",
+                    help="(with --jaxpr) rewrite the sharding manifest "
+                         "to the current spec surface and exit 0 "
+                         "(review the diff!)")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="include findings silenced by gomelint directives")
     ap.add_argument("--list-rules", action="store_true")
@@ -89,6 +103,9 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if not args.paths:
         ap.error("no paths given (or use --list-rules)")
+    if args.update_manifest and not args.jaxpr:
+        ap.error("--update-manifest requires --jaxpr (the manifest "
+                 "derives from the shared engine trace)")
 
     select = {s.strip().upper() for s in args.select.split(",") if s.strip()}
     findings = run_paths(args.paths, select or None,
@@ -104,6 +121,21 @@ def main(argv: list[str] | None = None) -> int:
         if not select or any(s.startswith("GL6") for s in select):
             from gome_tpu.analysis.donation import check_engine_donation
             traced.extend(check_engine_donation(args.dtype))
+        if not select or any(s.startswith("GL8") for s in select):
+            from gome_tpu.analysis.sharding import (
+                check_sharding_manifest,
+                extract_manifest,
+                save_manifest,
+            )
+            if args.update_manifest:
+                manifest = extract_manifest(args.dtype)
+                save_manifest(args.manifest, manifest)
+                print(f"gomelint: sharding manifest updated with "
+                      f"{len(manifest['entries'])} entr(ies) -> "
+                      f"{args.manifest}")
+                return 0
+            traced.extend(check_sharding_manifest(args.dtype,
+                                                  args.manifest))
         if not args.show_suppressed:
             traced = apply_file_suppressions(traced, root=ROOT)
         findings.extend(traced)

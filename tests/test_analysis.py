@@ -665,7 +665,8 @@ def test_cli_exits_zero_on_tree_and_lists_rules():
 def test_rule_catalogue_covers_all_families():
     from gome_tpu.analysis import envelope  # noqa: F401 — registers GL2xx
     cat = rule_catalogue()
-    for family in ("GL1", "GL2", "GL3", "GL4", "GL5", "GL6"):
+    for family in ("GL1", "GL2", "GL3", "GL4", "GL5", "GL6", "GL7",
+                   "GL8"):
         assert any(r.startswith(family) for r in cat), family
 
 
@@ -1234,3 +1235,357 @@ def test_committed_baseline_matches_tree():
     it fails CI."""
     r = _cli(["gome_tpu", "scripts", "bench.py"])
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# --- GL8xx sharding & partition consistency -------------------------------
+
+
+GL801_BAD = '''
+import jax
+from jax.sharding import PartitionSpec as P
+
+step_a = jax.jit(impl_a, in_shardings=(P('sym'),), out_shardings=(P('sym'),))
+step_b = jax.jit(impl_b, in_shardings=(P(None),), out_shardings=(P(None),))
+
+def frame(x):
+    y = step_a(x)
+    return step_b(y)                            # GL801: P('sym') -> P(None)
+'''
+
+GL801_GOOD = GL801_BAD.replace("P(None)", "P('sym')")
+
+
+def test_spec_mismatch_between_chained_entries():
+    findings = run_source(GL801_BAD, select={"GL8"})
+    assert rules_of(findings) == ["GL801"]
+    assert "P('sym')" in findings[0].message
+    assert "P(None)" in findings[0].message
+    assert run_source(GL801_GOOD, select={"GL8"}) == []
+
+
+GL801_FACTORY_BAD = '''
+import jax
+from jax.sharding import PartitionSpec as P
+
+def make_step(impl, mesh):
+    sharding = P('sym')
+    return jax.jit(impl, in_shardings=(sharding, sharding),
+                   out_shardings=(sharding, P(None)))
+
+def frame(impl, mesh, books, ops):
+    stepper = make_step(impl, mesh)
+    books, outs = stepper(books, ops)
+    books2, outs2 = stepper(books, outs)        # GL801 on arg #1
+    return books2, outs2
+'''
+
+GL801_FACTORY_GOOD = GL801_FACTORY_BAD.replace(
+    "(sharding, P(None))", "(sharding, sharding)")
+
+
+def test_spec_mismatch_through_factory_alias():
+    """The parallel/mesh.py idiom: a factory RETURNS the jitted entry,
+    callers alias it (`stepper = sharded_dense_step(...)`). Spec flow
+    must follow the alias and the tuple unpack; the alias-substituted
+    canonical form makes `sharding` and `P('sym')` compare equal."""
+    findings = run_source(GL801_FACTORY_BAD, select={"GL8"})
+    assert rules_of(findings) == ["GL801"]
+    assert "argument #1" in findings[0].message
+    assert run_source(GL801_FACTORY_GOOD, select={"GL8"}) == []
+
+
+def test_factory_call_itself_is_not_a_dispatch():
+    """Calling the factory only CONSTRUCTS the entry — the construction
+    call must not be treated as a sharded dispatch of its arguments."""
+    src = '''
+import jax
+from jax.sharding import PartitionSpec as P
+
+def make_step(impl):
+    return jax.jit(impl, in_shardings=(P('sym'),), out_shardings=(P('sym'),))
+
+def setup(impl_host):
+    return make_step(impl_host)
+'''
+    assert run_source(src, select={"GL8"}) == []
+
+
+GL802_BAD = '''
+import numpy as np
+
+class Eng:
+    def geometry(self, live):
+        d = self.mesh.size
+        local = self.n_slots // d
+        counts = np.bincount(live // local, minlength=d)
+        r_s = max(8, int(counts.max()))         # GL802 anchors here
+        if r_s * d >= self.n_slots:
+            return self.n_slots
+        n_rows = r_s * d
+        return n_rows
+'''
+
+GL802_GOOD = '''
+import numpy as np
+
+class Eng:
+    def geometry(self, live, shard_id):
+        d = self.mesh.size
+        local = self.n_slots // d
+        counts = np.bincount(live // local, minlength=d)
+        r_s = max(8, int(counts[shard_id]))     # per-shard, no reduction
+        return r_s * d
+'''
+
+
+def test_global_max_padding_flagged_once_at_derivation():
+    """One finding per derived block var, anchored at the derivation (the
+    line a fix rewrites), even when the product appears on several
+    lines; the telemetry-style inline `counts.max() * d` expression that
+    never lands in a variable is not the padding decision and must not
+    flag."""
+    findings = run_source(GL802_BAD, select={"GL8"})
+    assert rules_of(findings) == ["GL802"]
+    assert len(findings) == 1
+    assert findings[0].line == 9  # r_s = max(8, int(counts.max()))
+    assert "MULTICHIP_r06" in findings[0].message
+    assert run_source(GL802_GOOD, select={"GL8"}) == []
+
+
+def test_global_max_telemetry_expression_not_flagged():
+    src = '''
+import numpy as np
+
+def observe(skew, live, mesh):
+    d = mesh.size
+    counts = np.bincount(live, minlength=d)
+    skew.observe(int(counts.max()) * d / len(live))
+'''
+    assert run_source(src, select={"GL8"}) == []
+
+
+GL803_BAD = '''
+from zlib import crc32
+
+def route(symbol, n):
+    return crc32(symbol.encode()) % n           # GL803
+'''
+
+GL803_GOOD = '''
+from gome_tpu.fleet.router import partition_of
+
+def route(symbol, n):
+    return partition_of(symbol, n)
+'''
+
+
+def test_ad_hoc_partition_hash_flagged():
+    findings = run_source(GL803_BAD, select={"GL8"})
+    assert rules_of(findings) == ["GL803"]
+    assert "partition_of" in findings[0].message
+    assert run_source(GL803_GOOD, select={"GL8"}) == []
+
+
+def test_blessed_router_modules_may_hash():
+    """The one-policy rule needs an implementation somewhere: the blessed
+    placement helpers themselves are exempt, everything else routes
+    through them."""
+    for blessed in ("gome_tpu/fleet/router.py", "gome_tpu/parallel/router.py"):
+        assert run_source(GL803_BAD, path=blessed, select={"GL8"}) == []
+    assert rules_of(run_source(GL803_BAD, path="gome_tpu/fleet/drill.py",
+                               select={"GL8"})) == ["GL803"]
+
+
+GL804_BAD = '''
+import jax
+from jax.sharding import PartitionSpec as P
+
+step = jax.jit(impl, donate_argnums=(0,),
+               in_shardings=(P('sym'), P(None)), out_shardings=(P(None),))
+'''
+
+GL804_GOOD = GL804_BAD.replace("out_shardings=(P(None),)",
+                               "out_shardings=(P('sym'),)")
+
+
+def test_donation_across_sharding_boundary():
+    findings = run_source(GL804_BAD, select={"GL8"})
+    assert rules_of(findings) == ["GL804"]
+    assert "donated argument #0" in findings[0].message
+    assert run_source(GL804_GOOD, select={"GL8"}) == []
+
+
+def test_donation_without_shardings_is_gl6_territory():
+    """Plain donation with no spec surface stays GL6xx's audit — GL804
+    only speaks when both donation AND shardings are declared."""
+    src = '''
+import jax
+
+step = jax.jit(impl, donate_argnums=(0,))
+'''
+    assert run_source(src, select={"GL8"}) == []
+
+
+GL805_BAD = '''
+import jax
+import numpy as np
+
+def frame(mesh, books):
+    books = jax.device_put(books)
+    host = np.asarray(jax.device_get(books))
+    return shard_batch(mesh, host)              # GL805
+'''
+
+GL805_GOOD = '''
+import jax
+import numpy as np
+
+def frame(mesh, books):
+    books = jax.device_put(books)
+    return shard_batch(mesh, books)             # on-device reshard: fine
+'''
+
+
+def test_host_roundtrip_into_mesh_flagged():
+    findings = run_source(GL805_BAD, select={"GL8"})
+    assert rules_of(findings) == ["GL805"]
+    assert "round trip" in findings[0].message
+    assert run_source(GL805_GOOD, select={"GL8"}) == []
+
+
+def test_host_source_upload_is_clean():
+    """Placing genuinely host-born data (params, numpy construction) on
+    the mesh is the sanctioned upload path, not a round trip."""
+    src = '''
+import numpy as np
+
+def place(mesh, lane_ids):
+    ids_np = np.asarray(lane_ids)               # param: host-born
+    return shard_batch(mesh, ids_np)
+'''
+    assert run_source(src, select={"GL8"}) == []
+
+
+def test_host_roundtrip_through_factory_entry():
+    src = '''
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+def make_step(impl):
+    return jax.jit(impl, in_shardings=(P('sym'),), out_shardings=(P('sym'),))
+
+def frame(impl, books):
+    stepper = make_step(impl)
+    books = stepper(books)
+    host = np.asarray(books)
+    return stepper(host)                        # GL805
+'''
+    assert rules_of(run_source(src, select={"GL8"})) == ["GL805"]
+
+
+def test_gl8_suppression_and_select_compose():
+    suppressed = GL803_BAD.replace(
+        "% n           # GL803",
+        "% n  # gomelint: disable=GL803 — fixture")
+    assert run_source(suppressed, select={"GL8"}) == []
+    # family select keeps GL8 out of a GL5-only run and vice versa
+    assert run_source(GL803_BAD, select={"GL5"}) == []
+
+
+# --- GL806 sharding manifest ----------------------------------------------
+
+
+def test_manifest_extract_is_deterministic_and_complete():
+    from gome_tpu.analysis.sharding import extract_manifest
+
+    m = extract_manifest("int32")
+    assert m["dtype"] == "int32"
+    e = m["entries"]
+    batch = e["engine/batch.py:batch_step"]
+    assert batch["kind"] == "engine_entry"
+    assert batch["classification"] == "sym_sharded"
+    assert batch["donation"]["batch_step_donating"] == [2]
+    assert all(a.endswith(":int32") for a in batch["in_avals"])
+    dense = e["parallel/mesh.py:sharded_dense_step"]
+    assert dense["kind"] == "mesh_entry"
+    assert dense["mesh_axes"] == ["sym"]
+    assert dense["in_shardings"] == ["symbol_sharding(mesh)"] * 3
+    assert dense["shard_map_in_specs"] == ["P('sym')"] * 3
+    assert dense["shard_map_out_specs"] == ["P('sym')"] * 2
+    assert dense["classification"] == "shard_local"
+    # the best-effort pallas record must stay OUT: its presence varies
+    # by environment and the manifest must diff clean across machines
+    assert not any("pallas" in ctx for ctx in e)
+    assert extract_manifest("int32") == m
+
+
+def test_committed_manifest_matches_tree():
+    """The GL806 acceptance pin: the committed shard_manifest.json equals
+    the extracted spec surface — spec drift fails here (and in CI) until
+    --update-manifest is run and the diff reviewed."""
+    from gome_tpu.analysis.sharding import check_sharding_manifest
+
+    findings = check_sharding_manifest("int32")
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_manifest_missing_drift_and_dtype_gate(tmp_path):
+    from gome_tpu.analysis.sharding import (
+        check_sharding_manifest,
+        extract_manifest,
+        load_manifest,
+        save_manifest,
+    )
+
+    path = str(tmp_path / "manifest.json")
+    missing = check_sharding_manifest("int32", path)
+    assert rules_of(missing) == ["GL806"]
+    assert "no committed sharding manifest" in missing[0].message
+
+    save_manifest(path, extract_manifest("int32"))
+    assert check_sharding_manifest("int32", path) == []
+
+    doc = load_manifest(path)
+    doc["entries"]["parallel/mesh.py:sharded_dense_step"][
+        "shard_map_out_specs"] = ["P(None)", "P(None)"]
+    save_manifest(path, doc)
+    drift = check_sharding_manifest("int32", path)
+    assert rules_of(drift) == ["GL806"]
+    assert "sharded_dense_step" in drift[0].message
+    assert "shard_map_out_specs" in drift[0].message
+
+    doc["entries"].pop("engine/batch.py:batch_step")
+    doc["entries"]["engine/batch.py:imaginary"] = {"kind": "engine_entry"}
+    save_manifest(path, doc)
+    msgs = [f.message for f in check_sharding_manifest("int32", path)]
+    assert any("batch_step: entry is new" in m for m in msgs)
+    assert any("imaginary: entry vanished" in m for m in msgs)
+
+    # the manifest pins the CI dtype: audits of the OTHER dtype skip it
+    assert check_sharding_manifest("int64", path) == []
+
+
+def test_cli_update_manifest_requires_jaxpr():
+    r = _cli(["gome_tpu", "--update-manifest"])
+    assert r.returncode == 2
+    assert "--jaxpr" in r.stderr
+
+
+def test_cli_manifest_flow(tmp_path):
+    """CLI end-to-end: a missing manifest fails the GL8 gate with GL806;
+    --update-manifest writes the spec surface and exits 0 (the ratchet's
+    create/repair action, symmetric with --update-baseline)."""
+    path = str(tmp_path / "manifest.json")
+    r = _cli(["gome_tpu/parallel", "--jaxpr", "--select", "GL8",
+              "--manifest", path, "--no-baseline"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "GL806" in r.stdout
+
+    r = _cli(["gome_tpu/parallel", "--jaxpr", "--select", "GL8",
+              "--manifest", path, "--update-manifest"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    import json as _json
+    doc = _json.loads(open(path).read())
+    assert "parallel/mesh.py:sharded_dense_step" in doc["entries"]
+    assert doc["tool"].startswith("gomelint 2.")
