@@ -126,9 +126,14 @@ def part_a():
     )
 
     # Dense variant: 1024 live lanes of the 10240 (Zipf-ish live set).
+    # Keep the host-born lane ids around as numpy: both the unsharded
+    # device_put and the mesh placement shard from the host ORIGINAL —
+    # round-tripping the device copy back through np.asarray would pay
+    # device->host->device on the timed setup path (GL805).
     R = 1024
     dense_ops = jax.device_put(mk_grid(R))
-    lane_ids = jax.device_put(np.arange(R, dtype=np.int32))
+    ids_np = np.arange(R, dtype=np.int32)
+    lane_ids = jax.device_put(ids_np)
     eng2 = BatchEngine(config, n_slots=S, max_t=T, kernel="pallas")
     t_dense = time_step(
         lambda b, o: eng2._step(b, o, lane_ids), eng2.books, dense_ops
@@ -136,7 +141,7 @@ def part_a():
     results["dense_unsharded_ms"] = round(t_dense * 1e3, 3)
     dstepper = sharded_dense_step(config, mesh, kernel="pallas")
     books2 = shard_batch(mesh, init_books(config, S))
-    ids_m = shard_batch(mesh, np.asarray(lane_ids, np.int32))
+    ids_m = shard_batch(mesh, ids_np)
     dops_m = shard_batch(mesh, dense_ops)
     t_dense_m = time_step(
         lambda b, i, o: dstepper(b, i, o), books2, ids_m, dops_m

@@ -1589,3 +1589,37 @@ def test_cli_manifest_flow(tmp_path):
     doc = _json.loads(open(path).read())
     assert "parallel/mesh.py:sharded_dense_step" in doc["entries"]
     assert doc["tool"].startswith("gomelint 2.")
+
+
+def test_whole_tree_clean_for_sharding_family():
+    """Satellite guarantee for GL8xx: the mesh tier, the engine geometry,
+    and every script dispatch either satisfy the sharding rules or carry
+    a cited suppression (the GL802 global-max block in _grid_geometry is
+    owned by ROADMAP item 2) — regressions fail here with file:line."""
+    findings = [
+        f for f in run_paths([os.path.join(ROOT, "gome_tpu"),
+                              os.path.join(ROOT, "scripts"),
+                              os.path.join(ROOT, "bench.py")])
+        if f.rule.startswith("GL8")
+    ]
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_mesh_overhead_keeps_lane_ids_resident():
+    """Regression for the GL805 the tree sweep found: part_a built its
+    mesh lane ids by np.asarray(device_array) — a device->host->device
+    round trip on the setup path. The fix shards the host-born numpy
+    original; the file must stay GL805-clean."""
+    path = os.path.join(ROOT, "scripts", "mesh_overhead.py")
+    findings = [f for f in run_paths([path]) if f.rule == "GL805"]
+    assert findings == [], "\n".join(f.format() for f in findings)
+    # and the scan is not blind there: the old shape still fires
+    bad = '''
+import jax
+import numpy as np
+
+def part(mesh, R):
+    lane_ids = jax.device_put(np.arange(R, dtype=np.int32))
+    return shard_batch(mesh, np.asarray(lane_ids, np.int32))
+'''
+    assert rules_of(run_source(bad, select={"GL8"})) == ["GL805"]
