@@ -46,7 +46,11 @@ escalated to a WARNING line when a rows-per-live-lane p50 exceeds the
 placement fix lands. Also advisory: the SCALAR gateway admit surface
 (``gateway.scalar_admit_*``) — the single-order DoOrder path the
 columnar rework left intact — printed every run so the scalar-vs-
-columnar gap trends in every CI log.
+columnar gap trends in every CI log. And the GL8xx sharding surface
+(``sharding.manifest_entries`` / ``sharding.gl8xx_findings``): the
+committed shard-manifest entry count and the live sharding/partition
+finding count, advisory here because gomelint's analysis job already
+gates both (GL806 drift / new findings).
 
 Toolchain drift: the XLA numbers are deterministic per jaxlib VERSION,
 not across versions. The baseline records the jax version it was taken
@@ -376,6 +380,38 @@ def capacity_advisory() -> dict:
         return {"capacity.advisory_error": f"{type(exc).__name__}: {exc}"}
 
 
+def sharding_advisory() -> dict:
+    """GL8xx sharding surface (ISSUE 18), ADVISORY only.
+
+    Two rows: the committed sharding manifest's entry count (the GL806
+    ratchet surface — a shrinking count means an entry point silently
+    vanished from the traced/AST extraction and the manifest diff
+    deserves a look) and the live GL8xx finding count over the same
+    tree gomelint's CI invocation sweeps. Both already FAIL CI through
+    gomelint when they drift/regress; the advisory rows just put the
+    trend in every perf log. Never gated here — the gate belongs to
+    the analysis job."""
+    try:
+        from gome_tpu.analysis.core import run_paths
+        from gome_tpu.analysis.sharding import DEFAULT_MANIFEST, load_manifest
+
+        manifest = load_manifest(os.path.join(ROOT, DEFAULT_MANIFEST))
+        findings = run_paths(
+            [os.path.join(ROOT, "gome_tpu"),
+             os.path.join(ROOT, "scripts"),
+             os.path.join(ROOT, "bench.py")],
+            select={"GL8"},
+        )
+        return {
+            "sharding.manifest_entries": (
+                len(manifest["entries"]) if manifest else 0
+            ),
+            "sharding.gl8xx_findings": len(findings),
+        }
+    except Exception as exc:  # pragma: no cover - env-specific
+        return {"sharding.advisory_error": f"{type(exc).__name__}: {exc}"}
+
+
 def collect() -> dict:
     """{"jax": version, "gated": {...}, "advisory": {...}}."""
     import jax
@@ -395,6 +431,7 @@ def collect() -> dict:
     advisory.update(fleet_advisory())
     advisory.update(fleet_chaos_advisory())
     advisory.update(capacity_advisory())
+    advisory.update(sharding_advisory())
     return {
         "jax": jax.__version__,
         "gated": gated,
@@ -615,6 +652,14 @@ def main(argv: list[str] | None = None) -> int:
             "# WARNING (advisory, non-gating): the committed capacity "
             "verdict has pass=false — tests/test_capacity.py should be "
             "failing; investigate before trusting capacity numbers"
+        )
+    gl8 = current["advisory"].get("sharding.gl8xx_findings")
+    if gl8 is not None and gl8 > 0:
+        print(
+            f"# WARNING (advisory, non-gating): {gl8} live GL8xx "
+            "finding(s) in the tree — gomelint's analysis-job ratchet "
+            "should be failing; fix or suppress with an owning "
+            "workstream before trusting the sharding manifest"
         )
     if regressions:
         print(f"perf_ratchet: {len(regressions)} regressed metric(s):")
