@@ -269,6 +269,7 @@ class PartitionRouter:
     def partition(self, symbol: str) -> int:
         return partition_of(symbol, self.pmap.n_partitions)
 
+    # gomelint: hotpath — every order resolves its target member here
     def route(self, symbol: str) -> str:
         """Owner of `symbol`'s partition; RouteUnavailable if DOWN."""
         p, member = self.pmap.owner_of_symbol(symbol)
@@ -276,6 +277,7 @@ class PartitionRouter:
             raise RouteUnavailable(symbol, p, member)
         return member
 
+    # gomelint: hotpath — batch dispatch routes whole partitions here
     def route_partition(self, partition: int) -> str:
         member = self.pmap.owner(partition)
         if self.gate.is_down(member):

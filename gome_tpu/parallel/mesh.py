@@ -75,6 +75,7 @@ def symbol_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(SYM_AXIS))
 
 
+# gomelint: hotpath — per-dispatch mesh placement of the ops grid
 def shard_batch(mesh: Mesh, tree):
     """Place a [S, ...]-leaved pytree (BookState stack or DeviceOp grid)
     with the leading axis split across the mesh."""
