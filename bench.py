@@ -1068,7 +1068,7 @@ def service_main():
         # The drill's own compile trajectory: how many distinct dispatch
         # shape combos this flow minted (the perf ratchet gates the
         # scripted-drill equivalent).
-        analytic["compiled_frame_combos"] = len(engine.batch._seen_combos)
+        analytic["compiled_frame_combos"] = engine.batch.combo_count()
         result["analytic"] = analytic
     measured = _measured_block("int32")
     if measured is not None:

@@ -321,6 +321,7 @@ def _nop_grid(config: BookConfig, n_slots: int, t: int) -> dict[str, np.ndarray]
     )
 
 
+# gomesurface: quantizer
 def _next_pow2(n: int) -> int:
     p = 1
     while p < n:
@@ -335,6 +336,7 @@ def _next_pow2(n: int) -> int:
 CAP_CLASS_MIN = 64
 
 
+# gomesurface: quantizer
 def _cap_ladder(cap: int) -> list[int]:
     """The per-grid cap classes available under a storage cap: pow4 steps
     from CAP_CLASS_MIN (64, 256, 1024, ...) strictly below `cap`, plus
@@ -421,6 +423,7 @@ def _scatter_books_cap(books: BookState, lane_ids, sub: BookState, cap: int):
     )
 
 
+# gomesurface: quantizer
 def _next_pow4(n: int) -> int:
     """Coarser shape bucket for a frame's train grids: every distinct
     compiled shape costs a trace, and the train's later grids see
@@ -757,6 +760,7 @@ class BatchEngine:
     # Buffer-floor helpers (shared with frames._compact_sizes): floors
     # are {pow2 op-class: slot count}; an int means "this size, in its
     # own class".
+    # gomesurface: quantizer
     @staticmethod
     def _buf_class(n: int) -> int:
         return _next_pow2(max(n, 64))
@@ -867,6 +871,7 @@ class BatchEngine:
             cap=self.config.cap,
         )
 
+    # gomesurface: combo(persist)
     def shape_manifest(self) -> dict:
         """Everything a future process needs to run this flow's fast path
         with ZERO first-seen traces: the grow-only floors (so the same
@@ -876,8 +881,39 @@ class BatchEngine:
         traces are per-process and this closes that gap."""
         return dict(
             floors=self.geometry_floors(),
-            combos=sorted(self._seen_combos),
+            combos=self.combos(),
         )
+
+    # Dispatch-combo chokepoint: the ONLY writer of the recorded shape
+    # set. Everything outside this class — the frame dispatch, geometry
+    # replay, observability probes, benches — goes through these four
+    # accessors; gomesurface GL902 flags any `_seen_combos` reach-through
+    # so a new reader/writer can't silently fork the combo bookkeeping
+    # the steady-state (zero-recompile) contract hangs off.
+    def record_combo(self, combo) -> bool:
+        """Record one dispatched shape combo (tuple-ified). Returns True
+        when the combo is first-seen — i.e. the dispatch that produced it
+        just paid (or, for precompile replay, just prepaid) a jit
+        trace+compile."""
+        combo = tuple(combo)
+        if combo in self._seen_combos:
+            return False
+        self._seen_combos.add(combo)
+        return True
+
+    def combo_seen(self, combo) -> bool:
+        """Whether this shape combo has already been traced+compiled."""
+        return tuple(combo) in self._seen_combos
+
+    def combo_count(self) -> int:
+        """How many distinct dispatch shape combos this engine compiled —
+        the number the perf ratchet gates for the scripted drill."""
+        return len(self._seen_combos)
+
+    def combos(self) -> list:
+        """The recorded dispatch combos, sorted (stable across runs for
+        manifests and tests)."""
+        return sorted(self._seen_combos)
 
     def _grid_geometry(self, live: np.ndarray, first: bool = True,
                        cls: int | None = None):

@@ -70,6 +70,35 @@ _GRID_FIELDS = DeviceOp._fields  # one canonical field list + order
 #: few-row (hot-lane) grids.
 _REC_ELEM_BUDGET = 1 << 24
 
+#: Hard per-frame op ceiling (wire contract, enforced in _frame_arrays).
+#: This is what makes the m_pad / e_fills / e_cancels / totals_len combo
+#: dimensions FINITE: every one of them is a quantized function of the
+#: frame's op count, so bounding the op count bounds the compile surface
+#: (analysis.surface GL905 derives the committed combo universe from it).
+#: 1M ops/frame is ~100x the largest replay burst; a frame this large is
+#: a producer bug, not traffic.
+MAX_FRAME_OPS = 1 << 20
+
+#: The frame-dispatch combo key, field by field, in tuple order. This is
+#: the spine of the gomesurface GL902 site-agreement check: the build
+#: tuple (submit_frame), every replay unpack (precompile_combos,
+#: obs.compile_journal.frame_combo_detail), and the persisted manifest
+#: (BatchEngine.shape_manifest) must all agree with THIS declaration —
+#: adding a dimension means updating every site in one commit, and lint
+#: fails until they line up.
+COMBO_FIELDS = (
+    "n_rows",      # grid rows (live-lane bucket or full n_slots)
+    "t_grid",      # grid time-axis depth (packed-train class)
+    "cap_g",       # book capacity class dispatched against
+    "dense",       # full-grid (False) vs compact gather/scatter (True=
+                   # lane_ids present) dispatch path
+    "m_pad",       # packed-op axis length (pow4 of the frame op count)
+    "k_rec",       # step record depth min(max_fills, cap)
+    "e_fills",     # fills compaction buffer width (pow2 + grow-only floor)
+    "e_cancels",   # cancels compaction buffer width
+    "totals_len",  # per-grid totals buffer length
+)
+
 
 def _lane_map(eng: BatchEngine, symbols) -> np.ndarray:
     """symbol-dictionary -> lane-id array, cached by dictionary identity.
@@ -110,6 +139,13 @@ def _frame_arrays(eng: BatchEngine, cols: dict) -> dict:
     """Stage 1: vectorized interning, contract checks, envelope/drop mask,
     and per-lane slot assignment. Returns the arrays grid packing needs."""
     n = int(cols["n"])
+    if n > MAX_FRAME_OPS:
+        raise ValueError(
+            f"frame has {n} ops, above the MAX_FRAME_OPS contract ceiling "
+            f"({MAX_FRAME_OPS}); split the frame — the compile-surface "
+            "bound (analysis/combo_universe.json) is derived from this "
+            "limit"
+        )
     action = np.ascontiguousarray(cols["action"], np.int64)
     side = np.ascontiguousarray(cols["side"], np.int64)
     kind = np.ascontiguousarray(cols["kind"], np.int64)
@@ -649,6 +685,7 @@ class PendingFrame:
         self.n_kept = n_kept
 
 
+# gomesurface: combo(build)
 def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
     """Dispatch every grid of the frame + its device-side compaction
     back-to-back (no host sync) and start the async device->host copy of
@@ -712,11 +749,11 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
                 # (dispatch itself is async), which is exactly the
                 # invisible-latency-cliff the span taxonomy calls out.
                 TRACER.observe_span(
-                    "compile_hit" if combo in eng._seen_combos
+                    "compile_hit" if eng.combo_seen(combo)
                     else "compile_miss",
                     t_disp, TRACER.clock(),
                 )
-            if JOURNAL.enabled and combo not in eng._seen_combos:
+            if JOURNAL.enabled and not eng.combo_seen(combo):
                 # Compile journal: the SAME miss path, but recording the
                 # combo itself (plus its analytic cost block) — the
                 # histogram can only say a compile happened, the journal
@@ -730,7 +767,7 @@ def submit_frame(eng: BatchEngine, cols: dict) -> PendingFrame:
                         np.dtype(eng.config.dtype).name, combo
                     ),
                 )
-            eng._seen_combos.add(combo)
+            eng.record_combo(combo)
         eng.books = books
         if grids:
             from .batch import _cap_ladder
@@ -903,6 +940,7 @@ def apply_frame_fast(eng: BatchEngine, cols: dict):
         raise
 
 
+# gomesurface: quantizer
 def _compact_sizes(eng, n_ops: int, n_dels: int) -> tuple[int, int]:
     """Compaction buffer sizes for a grid of n_ops packed ops (n_dels of
     them DELs). Sizes MUST be pow2-bucketed: every distinct size is a
@@ -941,6 +979,7 @@ def _compact_sizes(eng, n_ops: int, n_dels: int) -> tuple[int, int]:
     return fills, cancels
 
 
+# gomesurface: combo(replay), precompile
 def precompile_combos(eng: BatchEngine, combos) -> int:
     """Replay recorded fast-path shape combos (BatchEngine.shape_manifest
     "combos") with ALL-PADDING inputs, forcing every jit trace+compile the
@@ -1002,7 +1041,7 @@ def precompile_combos(eng: BatchEngine, combos) -> int:
         except Exception:
             failed += 1
             continue
-        eng._seen_combos.add(combo)
+        eng.record_combo(combo)
         replayed += 1
     if failed:
         from ..utils.logging import get_logger

@@ -328,7 +328,8 @@ class MatchEngine:
                 cancels_buf=as_int(floors.get("cancels_buf", {})),
             )
             if not precompile:
-                self.batch._seen_combos |= set(map(tuple, combos))
+                for combo in combos:
+                    self.batch.record_combo(combo)
                 return 0
             return frames.precompile_combos(self.batch, combos)
         except Exception as e:

@@ -14,7 +14,8 @@ cooperating pieces:
     memo's canonical geometry; includes the donation-effectiveness report
     (public entry vs its ``_donating`` twin).
   * ``compile_journal`` — a bounded journal of jit trace+compile events,
-    hooked on the ``_seen_combos`` miss path in ``engine.frames``;
+    hooked on the first-seen-combo miss path in ``engine.frames``
+    (``BatchEngine.record_combo`` is the single writer);
     exported as ``gome_compile_seconds{entry=...}`` metrics and the ops
     ``/cost`` endpoint. Same hot-path contract as ``utils.trace``:
     disabled (the default) it costs one attribute check and ZERO

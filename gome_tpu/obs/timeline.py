@@ -343,7 +343,7 @@ def service_timeline(service, sampler: TimelineSampler | None = None):
             "frame_fallbacks": int(st.frame_fallbacks),
             "cap": int(batch.config.cap),
             "n_slots": int(batch.n_slots),
-            "seen_combos": len(batch._seen_combos),
+            "seen_combos": batch.combo_count(),
             "geometry_hash": geometry_manifest_hash(batch),
         }
 

@@ -53,7 +53,7 @@ from .metrics import REGISTRY, Registry
 #: The span taxonomy, in pipeline order. `compile_miss`/`compile_hit`
 #: split the device-dispatch cost by whether the shape combo had been
 #: traced+compiled before (engine.frames.submit_frame keys on
-#: BatchEngine._seen_combos).
+#: BatchEngine.combo_seen).
 STAGES = (
     "ingress",        # gateway: validate + pre-pool mark
     "enqueue",        # gateway: hand-off to the batcher / order queue

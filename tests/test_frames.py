@@ -384,7 +384,7 @@ def test_geometry_manifest_precompile_round_trip(tmp_path):
         e1.mark(o)
     frame = colwire.decode_order_frame(orders_to_frame(orders))
     ev1 = e1.process_frame(frame, fast=True).to_results()
-    assert e1.batch._seen_combos, "fast path recorded no shape combos"
+    assert e1.batch.combo_count(), "fast path recorded no shape combos"
     path = str(tmp_path / "geometry.json")
     e1.save_geometry(path)
 
@@ -392,7 +392,7 @@ def test_geometry_manifest_precompile_round_trip(tmp_path):
     # by the replay and (b) produce identical events.
     e2 = mk()
     n = e2.load_geometry(path)
-    assert n == len(e1.batch._seen_combos)
+    assert n == e1.batch.combo_count()
     assert int(np.asarray(e2.books.count).sum()) == 0  # replay mutated nothing
     assert e2.batch.stats.orders == 0
     # Floors were prewarmed: the same flow chooses the recorded shapes.
@@ -406,7 +406,9 @@ def test_geometry_manifest_precompile_round_trip(tmp_path):
     assert ev1 == ev2
     # The flow minted no shapes beyond the manifest (zero first-seen
     # traces in the "timed region").
-    assert e2.batch._seen_combos <= set(map(tuple, e1.batch.shape_manifest()["combos"]))
+    assert set(e2.batch.combos()) <= set(
+        map(tuple, e1.batch.shape_manifest()["combos"])
+    )
 
     # Missing/corrupt files are best-effort no-ops.
     e3 = mk()

@@ -151,7 +151,7 @@ def test_journal_records_miss_not_hit():
         assert all(e["entry"] == "frame_dispatch" for e in first)
         for e in first:
             assert e["seconds"] >= 0
-            assert tuple(e["key"]) in eng._seen_combos
+            assert eng.combo_seen(e["key"])
             d = e["detail"]
             for key in (
                 "grid_cells", "upload_bytes", "ops_grid_bytes",
