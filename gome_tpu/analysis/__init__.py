@@ -32,6 +32,17 @@ package checks those contracts *statically*, before a soak test runs:
                              companion — an Eraser-style lockset detector
                              + seeded interleaving driver — lives in
                              analysis.racecheck / analysis.interleave
+  GL8xx  sharding           — partition-spec flow between sharded entries,
+                             global-max padding, ad-hoc partition hashing,
+                             cross-spec donation, host round trips, and
+                             the committed shard_manifest.json drift
+                             ratchet (analysis.sharding)
+  GL9xx  compile-surface    — quantizer-lattice taint on jit shape sinks,
+                             combo-key site agreement, precompile-replay
+                             coverage, hot-path geometry resets, the
+                             committed combo_universe.json bound, and the
+                             runtime journal-escape cross-check
+                             (analysis.surface)
 
 Run it via ``python scripts/gomelint.py gome_tpu`` (CI's analysis job) or
 programmatically through :func:`run_paths`. Findings carry stable rule

@@ -24,13 +24,16 @@ import ast
 import dataclasses
 import os
 import re
+from typing import Callable
 
 #: Tool version (CLI --version, SARIF tool.driver.version, baseline
 #: provenance). Bump on rule-semantics changes: a fingerprint computed by
 #: one major version may legitimately churn under the next. 2.1.0:
 #: occurrence indices are file-scoped (cross-file duplicate keys no
 #: longer renumber each other) and the GL8xx sharding family exists.
-TOOL_VERSION = "2.1.0"
+#: 2.2.0: the GL9xx compile-surface family (and its combo-universe
+#: manifest) exists.
+TOOL_VERSION = "2.2.0"
 
 #: rule id -> one-line description (the catalogue; checkers register into
 #: this at import time so the CLI's --list-rules stays complete).
@@ -104,12 +107,17 @@ class SourceModule:
         return text[idx:] if idx >= 0 else ""
 
 
+#: A module checker: fn(module) -> findings.
+Checker = Callable[[SourceModule], list[Finding]]
+#: A project checker: fn(project) -> findings.
+ProjectChecker = Callable[["Project"], list[Finding]]
+
 #: registered checkers: (family, fn). Family is the id prefix ("GL1") used
 #: by --select; fn(module) -> findings.
-CHECKERS: list[tuple[str, object]] = []
+CHECKERS: list[tuple[str, Checker]] = []
 
 
-def register_checker(family: str, fn) -> None:
+def register_checker(family: str, fn: Checker) -> None:
     CHECKERS.append((family, fn))
 
 
@@ -117,10 +125,10 @@ def register_checker(family: str, fn) -> None:
 #: EVERY module of the run at once — the interprocedural passes (hot-path
 #: reachability, donation call-site liveness) need the whole-package call
 #: graph, which no single-module pass can build.
-PROJECT_CHECKERS: list[tuple[str, object]] = []
+PROJECT_CHECKERS: list[tuple[str, ProjectChecker]] = []
 
 
-def register_project_checker(family: str, fn) -> None:
+def register_project_checker(family: str, fn: ProjectChecker) -> None:
     PROJECT_CHECKERS.append((family, fn))
 
 
@@ -167,6 +175,7 @@ def _ensure_checkers_loaded() -> None:
         locks,
         recompile,
         sharding,
+        surface,
         threads,
         trace_safety,
         transfers,

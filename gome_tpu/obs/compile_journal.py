@@ -5,7 +5,8 @@ The span taxonomy (utils.trace) already SPLITS dispatch cost into
 compile happened, not WHICH shape caused it — and the invisible-latency
 cliff the ROADMAP calls out is always a specific first-seen combo
 arriving mid-traffic. The journal records, per miss on the
-``engine.frames`` ``_seen_combos`` path: the full dispatch combo key, the
+``engine.frames`` first-seen-combo path (``BatchEngine.combo_seen`` /
+``record_combo``): the full dispatch combo key, the
 trace+compile wall-clock it cost, and an analytic detail block (grid
 cells, op-grid / record / fetch-buffer bytes, scatter-jaxpr op count).
 Operators read it three ways:
@@ -143,6 +144,14 @@ class CompileJournal:
             "summary": self.summary(),
         }
 
+    def export(self) -> dict:
+        """The artifact wire form consumed by the GL906 escape check
+        (``analysis.surface.check_journal_escape``): ``as_dict`` plus a
+        schema tag so soak/chaos/obs_snapshot dumps stay parseable as
+        the format evolves. Every recorded ``frame_dispatch`` key is
+        checked against the committed combo universe."""
+        return {"schema": "gome-compile-journal/1", **self.as_dict()}
+
 
 #: Process-global journal (disabled until something installs it — the
 #: service wires it from ``ops.cost`` at boot, service.app).
@@ -184,6 +193,7 @@ def _scatter_eqn_count(dtype_name: str, n_rows: int, t_grid: int) -> int:
         return -1
 
 
+# gomesurface: combo(replay)
 def frame_combo_detail(dtype_name: str, combo: tuple) -> dict:
     """Analytic cost block for one frame dispatch combo
     (engine.frames.submit_frame records tuples of (n_rows, t_grid, cap_g,

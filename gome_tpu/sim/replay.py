@@ -184,7 +184,7 @@ def orders_from_grid(ops: dict, drop_misses: bool = False) -> list:
 # -- GCO record mode --------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnums=0)
-def _record_step(config: EnvConfig, state):
+def _record_step(config: EnvConfig, state):  # gomelint: disable=GL903 — offline record tool: one compile per config, paid at session start before any frame traffic; not a frame-dispatch combo, so the boot replay can't (and needn't) reach it
     """One background-only env transition that ALSO returns the generated
     grid. gen_ops is pure in (flow state, books), so re-deriving the grid
     here is bit-identical to the one `_env_step_impl` applies (and XLA

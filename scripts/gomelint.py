@@ -26,6 +26,15 @@ gome_tpu/analysis/sharding.py, and ARCHITECTURE.md "Static analysis".
 manifest (gome_tpu/analysis/shard_manifest.json, override with
 --manifest) to the current spec surface; like --update-baseline, the
 diff is reviewed, not silently absorbed.
+
+The GL9xx compile-surface family (gome_tpu/analysis/surface.py) splits
+three ways: GL901-GL904 are AST rules that ride the normal run; GL905
+(combo-universe drift vs gome_tpu/analysis/combo_universe.json, override
+with --universe, regenerate with `--jaxpr --update-universe`) shares the
+--jaxpr engine import; and `--journal FILE` runs the GL906 runtime-escape
+check — a compile-journal export (soak/chaos/obs_snapshot artifact)
+checked combo-by-combo against the COMMITTED universe, pure JSON, no
+--jaxpr needed.
 CI's dedicated race job re-runs `--select GL7` (the thread-escape
 family, AST-only, so thread-discipline regressions are named by rule)
 before the scripts/race_drill.py lockset drill.
@@ -54,6 +63,7 @@ from gome_tpu.analysis.core import (  # noqa: E402
     _ensure_checkers_loaded,
 )
 from gome_tpu.analysis.sharding import DEFAULT_MANIFEST  # noqa: E402
+from gome_tpu.analysis.surface import DEFAULT_UNIVERSE  # noqa: E402
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -88,6 +98,18 @@ def main(argv: list[str] | None = None) -> int:
                     help="(with --jaxpr) rewrite the sharding manifest "
                          "to the current spec surface and exit 0 "
                          "(review the diff!)")
+    ap.add_argument("--universe",
+                    default=os.path.join(ROOT, DEFAULT_UNIVERSE),
+                    help="combo-universe manifest for the GL905 drift "
+                         f"ratchet (default: {DEFAULT_UNIVERSE})")
+    ap.add_argument("--update-universe", action="store_true",
+                    help="(with --jaxpr) rewrite the combo universe to "
+                         "the current engine bounds and exit 0 "
+                         "(review the diff!)")
+    ap.add_argument("--journal", default="",
+                    help="compile-journal export (JSON) to check against "
+                         "the committed combo universe (GL906; no "
+                         "--jaxpr needed)")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="include findings silenced by gomelint directives")
     ap.add_argument("--list-rules", action="store_true")
@@ -106,6 +128,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.update_manifest and not args.jaxpr:
         ap.error("--update-manifest requires --jaxpr (the manifest "
                  "derives from the shared engine trace)")
+    if args.update_universe and not args.jaxpr:
+        ap.error("--update-universe requires --jaxpr (the universe "
+                 "derives from the engine's config bounds)")
 
     select = {s.strip().upper() for s in args.select.split(",") if s.strip()}
     findings = run_paths(args.paths, select or None,
@@ -136,9 +161,27 @@ def main(argv: list[str] | None = None) -> int:
                 return 0
             traced.extend(check_sharding_manifest(args.dtype,
                                                   args.manifest))
+        if not select or any(s.startswith("GL9") for s in select):
+            from gome_tpu.analysis.surface import (
+                check_universe,
+                extract_universe,
+                save_universe,
+            )
+            if args.update_universe:
+                universe = extract_universe()
+                save_universe(args.universe, universe)
+                print(f"gomelint: combo universe updated with "
+                      f"{len(universe['dimensions'])} dimension(s) -> "
+                      f"{args.universe}")
+                return 0
+            traced.extend(check_universe(args.universe))
         if not args.show_suppressed:
             traced = apply_file_suppressions(traced, root=ROOT)
         findings.extend(traced)
+    if args.journal and (not select
+                         or any(s.startswith("GL9") for s in select)):
+        from gome_tpu.analysis.surface import check_journal_escape
+        findings.extend(check_journal_escape(args.journal, args.universe))
 
     fingerprinted = fingerprint_findings(findings, root=ROOT)
     if args.update_baseline:

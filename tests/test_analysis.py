@@ -666,7 +666,7 @@ def test_rule_catalogue_covers_all_families():
     from gome_tpu.analysis import envelope  # noqa: F401 — registers GL2xx
     cat = rule_catalogue()
     for family in ("GL1", "GL2", "GL3", "GL4", "GL5", "GL6", "GL7",
-                   "GL8"):
+                   "GL8", "GL9"):
         assert any(r.startswith(family) for r in cat), family
 
 
@@ -1623,3 +1623,505 @@ def part(mesh, R):
     return shard_batch(mesh, np.asarray(lane_ids, np.int32))
 '''
     assert rules_of(run_source(bad, select={"GL8"})) == ["GL805"]
+
+
+# --- GL9xx compile surface -------------------------------------------------
+
+
+SURFACE_OK = '''
+import jax
+from functools import lru_cache
+
+# gomesurface: quantizer
+def _pow2(n):
+    return 1 << max(n - 1, 0).bit_length()
+
+# gomesurface: quantizer
+def _pow4(n):
+    v = 1
+    while v < n:
+        v *= 4
+    return v
+
+COMBO_FIELDS = ("n_rows", "cap_g")
+
+@lru_cache(maxsize=None)
+def make_step(n_rows, cap_g):
+    @jax.jit
+    def step(x):
+        return x[:n_rows, :cap_g]
+    return step
+
+# gomesurface: combo(build)
+def submit(eng, ops, counts):  # gomelint: hotpath
+    rows = _pow2(len(ops))
+    cap = _pow2(counts.max())
+    combo = (rows, cap)
+    eng.record_combo(combo)
+    return make_step(rows, cap)(ops)
+
+# gomesurface: combo(replay), precompile
+def boot_replay(eng):
+    for combo in eng.combos():
+        (n_rows, cap_g) = combo
+        make_step(n_rows, cap_g)
+
+# gomesurface: combo(persist)
+def manifest(eng):
+    return {"combos": sorted(eng.combos())}
+'''
+
+
+def _gl9(src, **kw):
+    return run_source(src, select={"GL9"}, **kw)
+
+
+def test_surface_complete_fixture_is_clean():
+    """The whole contract composed: quantized build, agreeing replay
+    unpack, persist through combos(), precompile covering the factory —
+    every GL901-GL904 check stays silent at once."""
+    assert _gl9(SURFACE_OK) == []
+
+
+def test_gl901_raw_reduction_to_combo_and_factory():
+    bad = SURFACE_OK.replace("_pow2(len(ops))", "len(ops)")
+    findings = _gl9(bad)
+    assert rules_of(findings) == ["GL901"]
+    msgs = "\n".join(f.message for f in findings)
+    assert "combo dimension 'n_rows'" in msgs
+    assert "shape argument #0 of jit factory make_step()" in msgs
+
+
+def test_gl901_attribute_reduction_is_a_source():
+    bad = SURFACE_OK.replace("_pow2(counts.max())", "counts.max()")
+    findings = _gl9(bad)
+    assert rules_of(findings) == ["GL901"]
+    assert any("combo dimension 'cap_g'" in f.message for f in findings)
+
+
+def test_gl901_quantizer_alias_launders():
+    """`bucket = _pow2 if first else _pow4; bucket(len(ops))` — an alias
+    of a quantizer is a quantizer (the batch.py first-grow idiom)."""
+    src = SURFACE_OK + '''
+def resize(eng, ops, first):  # gomelint: hotpath
+    bucket = _pow2 if first else _pow4
+    m = bucket(len(ops))
+    return make_step(m, 8)(ops)
+'''
+    assert _gl9(src) == []
+    # and the scan is not blind: drop the laundering call, it fires
+    raw = src.replace("bucket(len(ops))", "len(ops)")
+    assert rules_of(_gl9(raw)) == ["GL901"]
+
+
+def test_gl902_build_arity_drift():
+    bad = SURFACE_OK.replace("combo = (rows, cap)", "combo = (rows, cap, 7)")
+    findings = _gl9(bad)
+    assert rules_of(findings) == ["GL902"]
+    assert "3 element(s)" in findings[0].message
+    assert "COMBO_FIELDS declares 2" in findings[0].message
+
+
+def test_gl902_build_order_drift_via_provenance():
+    bad = SURFACE_OK.replace("combo = (rows, cap)", "combo = (cap, rows)")
+    findings = _gl9(bad)
+    assert rules_of(findings) == ["GL902"]
+    assert all("drifted" in f.message for f in findings)
+
+
+def test_gl902_replay_unpack_drift_and_oob_subscript():
+    bad = SURFACE_OK.replace("(n_rows, cap_g) = combo",
+                             "(cap_g, n_rows) = combo")
+    findings = _gl9(bad)
+    assert rules_of(findings) == ["GL902"]
+    assert "replay unpack binds (cap_g, n_rows)" in findings[0].message
+
+    oob = SURFACE_OK.replace("        make_step(n_rows, cap_g)",
+                             "        make_step(n_rows, combo[5])")
+    findings = _gl9(oob)
+    assert rules_of(findings) == ["GL902"]
+    assert "combo[5] is outside the 2-field combo layout" \
+        in findings[0].message
+
+
+def test_gl902_persist_must_read_the_combo_set():
+    bad = SURFACE_OK.replace('{"combos": sorted(eng.combos())}', "{}")
+    findings = _gl9(bad)
+    assert rules_of(findings) == ["GL902"]
+    assert "never reads the recorded combo set" in findings[0].message
+
+
+def test_gl902_missing_role_annotation():
+    bad = SURFACE_OK.replace("# gomesurface: combo(persist)\n", "")
+    findings = _gl9(bad)
+    assert rules_of(findings) == ["GL902"]
+    assert "combo(persist)" in findings[0].message
+
+
+def test_gl902_seen_combos_reach_through_regression():
+    """Regression pin for the sweep's chokepoint refactor: the
+    obs/timeline.py rollup used to read `len(eng._seen_combos)` directly;
+    it now goes through combo_count(). The OLD shape must keep firing
+    anywhere outside the chokepoint's home module..."""
+    reach = '''
+def rollup(eng):
+    return {"combos": len(eng._seen_combos)}
+'''
+    findings = _gl9(reach, path="obs/timeline.py")
+    assert rules_of(findings) == ["GL902"]
+    assert "record_combo" in findings[0].message
+    # ...while engine/batch.py, the set's single owner, is exempt.
+    assert _gl9(reach, path="engine/batch.py") == []
+
+
+def test_gl903_uncovered_hot_entry():
+    bad = SURFACE_OK.replace("# gomesurface: combo(replay), precompile",
+                             "# gomesurface: combo(replay)")
+    findings = _gl9(bad)
+    assert rules_of(findings) == ["GL903"]
+    # both the factory and its jitted inner are now unreachable at boot
+    msgs = "\n".join(f.message for f in findings)
+    assert "make_step" in msgs
+    assert "precompile" in msgs
+
+
+def test_gl903_silent_without_a_replay_system():
+    """A project with no precompile annotation AND no COMBO_FIELDS has
+    no replay system to register into — GL903 would be unactionable."""
+    src = '''
+import jax
+
+@jax.jit
+def step(x):
+    return x
+
+def hot(x):  # gomelint: hotpath
+    return step(x)
+'''
+    assert _gl9(src) == []
+
+
+def test_gl904_hot_path_resets():
+    bad = '''
+def drain(eng):  # gomelint: hotpath
+    reap(eng)
+
+def reap(eng):
+    eng.reset_geometry_floors()
+    eng._seen_combos.clear()
+'''
+    # path inside the chokepoint module isolates GL904 from the GL902
+    # reach-through rule
+    findings = _gl9(bad, path="engine/batch.py")
+    assert rules_of(findings) == ["GL904"]
+    msgs = "\n".join(f.message for f in findings)
+    assert "reset_geometry_floors()" in msgs
+    assert "_seen_combos.clear()" in msgs
+    # the same resets in maintenance code nothing hot reaches are fine
+    good = bad.replace("  # gomelint: hotpath", "")
+    assert _gl9(good, path="engine/batch.py") == []
+
+
+def test_gl9_suppression_composes():
+    src = '''
+def drain(eng):  # gomelint: hotpath
+    eng.reset_geometry_floors()  # gomelint: disable=GL904 — boot drain
+'''
+    assert _gl9(src, path="engine/batch.py") == []
+
+
+def test_whole_tree_clean_for_surface_family():
+    """Satellite guarantee for GL9xx: every engine quantizer is
+    annotated, the combo sites agree with COMBO_FIELDS, all hot jit
+    entries replay from precompile_combos, and no reset is hot-reachable
+    (the sim/replay.py record tool carries the one cited suppression)."""
+    findings = [
+        f for f in run_paths([os.path.join(ROOT, "gome_tpu"),
+                              os.path.join(ROOT, "scripts"),
+                              os.path.join(ROOT, "bench.py")])
+        if f.rule.startswith("GL9")
+    ]
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# --- GL905 combo universe --------------------------------------------------
+
+
+def test_universe_extract_is_deterministic_and_total():
+    from gome_tpu.analysis.surface import extract_universe
+    from gome_tpu.engine.frames import COMBO_FIELDS
+
+    u = extract_universe()
+    assert u["fields"] == list(COMBO_FIELDS)
+    assert list(u["dimensions"]) == list(COMBO_FIELDS)
+    for name, dim in u["dimensions"].items():
+        # no unbounded holes: every dimension has a real generator
+        assert dim["cardinality"] >= 1, name
+        assert "UNKNOWN" not in dim["generator"], name
+    assert u["cardinality_log2_bound"] > 0
+    assert u["bounds"]["max_frame_ops"] == 1 << 20
+    assert extract_universe() == u
+
+
+def test_committed_universe_matches_tree():
+    """The GL905 acceptance pin: the committed combo_universe.json equals
+    the extracted bound — a config-bound or quantizer change fails here
+    (and in CI) until --update-universe is run and the diff reviewed."""
+    from gome_tpu.analysis.surface import check_universe
+
+    findings = check_universe()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_universe_missing_drift_and_dimension_churn(tmp_path):
+    from gome_tpu.analysis.surface import (
+        check_universe,
+        extract_universe,
+        load_universe,
+        save_universe,
+    )
+
+    path = str(tmp_path / "universe.json")
+    missing = check_universe(path)
+    assert rules_of(missing) == ["GL905"]
+    assert "no committed combo universe" in missing[0].message
+
+    save_universe(path, extract_universe())
+    assert check_universe(path) == []
+
+    doc = load_universe(path)
+    doc["dimensions"]["t_grid"]["max"] = 2048
+    save_universe(path, doc)
+    drift = check_universe(path)
+    assert rules_of(drift) == ["GL905"]
+    assert "t_grid" in drift[0].message and "max" in drift[0].message
+
+    doc["dimensions"]["t_grid"]["max"] = 1024
+    doc["bounds"]["max_t"] = 64
+    doc["dimensions"].pop("m_pad")
+    doc["dimensions"]["imaginary"] = {"kind": "enum", "values": [1]}
+    save_universe(path, doc)
+    msgs = [f.message for f in check_universe(path)]
+    assert any("bounds changed" in m for m in msgs)
+    assert any("m_pad: dimension is new" in m for m in msgs)
+    assert any("imaginary: dimension vanished" in m for m in msgs)
+
+
+def test_cli_update_universe_requires_jaxpr():
+    r = _cli(["gome_tpu", "--update-universe"])
+    assert r.returncode == 2
+    assert "--jaxpr" in r.stderr
+
+
+def test_cli_universe_flow(tmp_path):
+    """CLI end-to-end: a missing universe fails the GL9 gate with GL905;
+    --update-universe writes the per-dimension bound and exits 0 (the
+    ratchet's create/repair action, symmetric with --update-manifest)."""
+    path = str(tmp_path / "universe.json")
+    r = _cli(["gome_tpu/analysis", "--jaxpr", "--select", "GL9",
+              "--universe", path, "--no-baseline"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "GL905" in r.stdout
+
+    r = _cli(["gome_tpu/analysis", "--jaxpr", "--select", "GL9",
+              "--universe", path, "--update-universe"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    import json as _json
+    doc = _json.loads(open(path).read())
+    assert len(doc["dimensions"]) == 9
+    assert doc["tool"].startswith("gomelint 2.")
+
+    r = _cli(["gome_tpu/analysis", "--jaxpr", "--select", "GL9",
+              "--universe", path, "--no-baseline"])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# --- GL906 runtime escape --------------------------------------------------
+
+
+#: A dispatch combo from the committed universe's interior (engine
+#: defaults: 8 rows, full 8-step grid, cap class 64, dense, the floors).
+_COMBO_IN = (8, 8, 64, True, 64, 4, 64, 64, 8)
+
+
+def test_combo_escapes_against_committed_universe():
+    from gome_tpu.analysis.surface import combo_escapes, load_universe
+
+    u = load_universe(os.path.join(ROOT, "gome_tpu", "analysis",
+                                   "combo_universe.json"))
+    assert u is not None
+    assert combo_escapes(_COMBO_IN, u) == []
+
+    off_lattice = (8, 48) + _COMBO_IN[2:]
+    [why] = combo_escapes(off_lattice, u)
+    assert "t_grid=48" in why and "pow2" in why
+
+    # m_pad is pow4: a pow2 value off the pow4 lattice escapes
+    not_pow4 = _COMBO_IN[:4] + (128,) + _COMBO_IN[5:]
+    [why] = combo_escapes(not_pow4, u)
+    assert "m_pad=128" in why
+
+    assert "arity" in combo_escapes(_COMBO_IN[:3], u)[0]
+
+
+def test_journal_escapes_wire_forms():
+    from gome_tpu.analysis.surface import _journal_entries, journal_escapes
+
+    entry = {"entry": "frame_dispatch", "key": list(_COMBO_IN)}
+    for doc in ([entry],
+                {"entries": [entry]},
+                {"schema": "gome-compile-journal/1", "entries": [entry]},
+                {"compile_journal": {"entries": [entry]}},
+                {"journal": {"entries": [entry]}}):
+        assert _journal_entries(doc) == [entry]
+    assert _journal_entries({"other": 1}) == []
+    assert _journal_entries("junk") == []
+
+    u = {"fields": ["n"], "dimensions": {"n": {"kind": "pow2",
+                                               "min": 8, "max": 64,
+                                               "cardinality": 4}}}
+    entries = [
+        {"entry": "frame_dispatch", "key": [32]},       # inside
+        {"entry": "frame_dispatch", "key": [48]},       # escapes
+        {"entry": "frame_dispatch", "key": [48]},       # dup: reported once
+        {"entry": "precompile_replay", "key": [999]},   # not a dispatch
+        {"entry": "frame_dispatch", "key": "notakey"},  # malformed: skipped
+    ]
+    escapes = journal_escapes(entries, u)
+    assert escapes == [((48,), ["n=48 outside pow2 [8..64]"])]
+
+
+def test_check_journal_escape_files(tmp_path):
+    import json as _json
+
+    from gome_tpu.analysis.surface import check_journal_escape
+
+    journal = tmp_path / "journal.json"
+    journal.write_text(_json.dumps(
+        {"entries": [{"entry": "frame_dispatch", "key": list(_COMBO_IN)}]}
+    ))
+    assert check_journal_escape(str(journal)) == []
+
+    missing = check_journal_escape(str(journal),
+                                   str(tmp_path / "absent.json"))
+    assert rules_of(missing) == ["GL906"]
+    assert "no committed combo universe" in missing[0].message
+
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    broken = check_journal_escape(str(bad))
+    assert rules_of(broken) == ["GL906"]
+    assert "unreadable" in broken[0].message
+
+    journal.write_text(_json.dumps(
+        {"entries": [{"entry": "frame_dispatch",
+                      "key": [8, 48] + list(_COMBO_IN[2:])}]}
+    ))
+    escape = check_journal_escape(str(journal))
+    assert rules_of(escape) == ["GL906"]
+    assert "escapes the predicted universe" in escape[0].message
+    assert "t_grid=48" in escape[0].message
+
+
+def test_cli_journal_flag(tmp_path):
+    import json as _json
+
+    ok = tmp_path / "ok.json"
+    ok.write_text(_json.dumps(
+        {"entries": [{"entry": "frame_dispatch", "key": list(_COMBO_IN)}]}
+    ))
+    r = _cli(["gome_tpu/analysis/surface.py", "--select", "GL9",
+              "--no-baseline", "--journal", str(ok)])
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(_json.dumps(
+        {"entries": [{"entry": "frame_dispatch",
+                      "key": [8, 48] + list(_COMBO_IN[2:])}]}
+    ))
+    r = _cli(["gome_tpu/analysis/surface.py", "--select", "GL9",
+              "--no-baseline", "--journal", str(bad)])
+    assert r.returncode == 1
+    assert "GL906" in r.stdout
+
+
+def test_gl906_dynamic_witness_drill():
+    """The runtime half of the contract, end to end on a live engine: a
+    discovery run's every recorded combo lies INSIDE the committed
+    universe (the static bound is sound for real traffic), and a fresh
+    engine that precompiles those combos replays the same flow with the
+    compile journal armed and SILENT (zero steady-state dispatches —
+    the ROADMAP item 3 property GL906 audits in CI artifacts)."""
+    import numpy as np
+
+    from gome_tpu.analysis.surface import (
+        combo_escapes,
+        journal_escapes,
+        load_universe,
+    )
+    from gome_tpu.engine import frames
+    from gome_tpu.engine.batch import BatchEngine
+    from gome_tpu.engine.book import BookConfig
+    from gome_tpu.engine.frames import precompile_combos
+    from gome_tpu.obs import CompileJournal
+    from gome_tpu.utils.metrics import Registry
+
+    def mk():
+        return BatchEngine(BookConfig(cap=64, max_fills=4,
+                                      dtype=jnp.int32),
+                           n_slots=16, max_t=8)
+
+    def mixed_frames():
+        out = []
+        rng = np.random.default_rng(7)
+        for i, n in enumerate((64, 17, 128)):
+            action = np.ones(n, np.int64)
+            action[rng.random(n) < 0.25] = 2  # mixed flow: adds + dels
+            out.append(dict(
+                n=n,
+                action=action,
+                side=rng.integers(0, 2, n).astype(np.int64),
+                kind=np.zeros(n, np.int64),
+                price=rng.integers(99_000, 101_000, n).astype(np.int64),
+                volume=rng.integers(1, 10, n).astype(np.int64),
+                symbols=[f"s{j}" for j in range(6)],
+                symbol_idx=rng.integers(0, 6, n).astype(np.int64),
+                uuids=["u0"],
+                uuid_idx=np.zeros(n, np.int64),
+                oids=np.char.add(
+                    "w", np.arange(i * 4096, i * 4096 + n).astype("U8")
+                ).astype("S"),
+            ))
+        return out
+
+    universe = load_universe(os.path.join(
+        ROOT, "gome_tpu", "analysis", "combo_universe.json"))
+    assert universe is not None
+
+    # Discovery: every combo real traffic mints is inside the bound.
+    e1 = mk()
+    for f in mixed_frames():
+        frames.apply_frame_fast(e1, f)
+    discovered = sorted(e1.combos())
+    assert discovered, "discovery run recorded no combos"
+    for combo in discovered:
+        assert combo_escapes(combo, universe) == [], combo
+
+    # Replay: precompile the manifest, arm the journal, re-run the flow.
+    e2 = mk()
+    assert precompile_combos(e2, e1.shape_manifest()["combos"]) \
+        == len(discovered)
+    journal = CompileJournal().install(keep_n=64, registry=Registry())
+    old = frames.JOURNAL
+    frames.JOURNAL = journal  # armed AFTER precompile: boot is off-book
+    try:
+        for f in mixed_frames():
+            frames.apply_frame_fast(e2, f)
+    finally:
+        frames.JOURNAL = old
+        journal.disable()
+    dispatches = [e for e in journal.entries()
+                  if e["entry"] == "frame_dispatch"]
+    assert dispatches == [], dispatches  # zero compiles at steady state
+    # and the export wire form the CI artifact check reads is escape-free
+    assert journal_escapes(journal.export()["entries"], universe) == []
