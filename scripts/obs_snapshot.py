@@ -164,6 +164,23 @@ def main(out_dir: str = "obs-artifacts") -> int:
     with open(os.path.join(out_dir, "cost.json"), "w") as f:
         json.dump(cost, f, indent=1, default=str)
 
+    # The journal alone, in its GL906 wire form: CI (and operators
+    # triaging a soak) feed this straight to
+    # `scripts/gomelint.py --journal compile_journal.json` to prove the
+    # observed dispatch combos never escaped the committed universe.
+    journal_doc = JOURNAL.export()
+    with open(os.path.join(out_dir, "compile_journal.json"), "w") as f:
+        json.dump(journal_doc, f, indent=1, default=str)
+    from gome_tpu.analysis.surface import journal_escapes, load_universe
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    universe = load_universe(
+        os.path.join(root, "gome_tpu", "analysis", "combo_universe.json")
+    )
+    assert universe is not None, "no committed combo universe"
+    escapes = journal_escapes(journal_doc["entries"], universe)
+    assert not escapes, f"combos escaped the static universe: {escapes}"
+
     TIMELINE.sample()  # post-drill sample: the series shows the drill
     timeline = ops.timeline_payload()
     assert timeline["enabled"], "ops.timeline did not arm the sampler"

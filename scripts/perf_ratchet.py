@@ -22,7 +22,7 @@ Gated metrics:
     dense_batch_step, lane_scan, compact_accum, scatter_grid) — lower
     is better, analytic, bit-exact per jaxlib version;
   * ``frame_drill.compile_count`` — distinct dispatch shape combos a
-    fixed scripted frame flow mints (the _seen_combos cardinality): a
+    fixed scripted frame flow mints (BatchEngine.combo_count()): a
     shape-oscillation regression (the class of bug the grow-only
     geometry ratchets exist to prevent) shows up here as an extra
     compile, gated at tolerance 0;
@@ -66,6 +66,7 @@ Exit codes: 0 ok / baseline updated; 1 regression or missing baseline;
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -82,7 +83,17 @@ DEFAULT_BASELINE = os.path.join(ROOT, "PERF_BASELINE.json")
 #: regression. Compile count is exact by construction: one extra
 #: compiled shape IS the regression.
 DEFAULT_TOLERANCE = 0.02
-EXACT_METRICS = ("frame_drill.compile_count",)
+#: surface.combo_universe_log2 is the GL905 universe's total cardinality
+#: bound (log2 of the product of per-dimension value-set sizes) — pure
+#: arithmetic over engine config bounds, independent of jax/jaxlib, so
+#: it stays gated even on a version mismatch. Growth means the compile
+#: surface widened (a config bound or quantizer changed); that is a
+#: reviewed decision (--update-universe + --update-baseline), never
+#: drift.
+EXACT_METRICS = (
+    "frame_drill.compile_count",
+    "surface.combo_universe_log2",
+)
 
 #: Wall-clock admit rows (round 11): gated, but with 3x headroom —
 #: limit = base * (1 + 2.0) for lower-is-better, base / (1 + 2.0) for
@@ -149,7 +160,7 @@ def frame_drill() -> dict:
     elapsed = time.perf_counter() - t0
     return {
         "gated": {
-            "frame_drill.compile_count": len(eng._seen_combos),
+            "frame_drill.compile_count": eng.combo_count(),
         },
         "advisory": {
             "frame_drill.orders": n_orders,
@@ -380,6 +391,30 @@ def capacity_advisory() -> dict:
         return {"capacity.advisory_error": f"{type(exc).__name__}: {exc}"}
 
 
+#: The gomelint sweeps and the universe extraction below read the SOURCE
+#: TREE, which is immutable for the life of a ratchet process — but the
+#: in-process test harness calls collect() several times per process,
+#: and re-running ~10s of AST analysis per call is pure waste. Cache per
+#: process; the CI script runs collect() once anyway.
+@functools.lru_cache(maxsize=None)
+def _family_findings(family: str) -> int:
+    from gome_tpu.analysis.core import run_paths
+
+    return len(run_paths(
+        [os.path.join(ROOT, "gome_tpu"),
+         os.path.join(ROOT, "scripts"),
+         os.path.join(ROOT, "bench.py")],
+        select={family},
+    ))
+
+
+@functools.lru_cache(maxsize=1)
+def _universe_log2() -> float:
+    from gome_tpu.analysis.surface import extract_universe
+
+    return float(extract_universe()["cardinality_log2_bound"])
+
+
 def sharding_advisory() -> dict:
     """GL8xx sharding surface (ISSUE 18), ADVISORY only.
 
@@ -392,24 +427,45 @@ def sharding_advisory() -> dict:
     trend in every perf log. Never gated here — the gate belongs to
     the analysis job."""
     try:
-        from gome_tpu.analysis.core import run_paths
         from gome_tpu.analysis.sharding import DEFAULT_MANIFEST, load_manifest
 
         manifest = load_manifest(os.path.join(ROOT, DEFAULT_MANIFEST))
-        findings = run_paths(
-            [os.path.join(ROOT, "gome_tpu"),
-             os.path.join(ROOT, "scripts"),
-             os.path.join(ROOT, "bench.py")],
-            select={"GL8"},
-        )
         return {
             "sharding.manifest_entries": (
                 len(manifest["entries"]) if manifest else 0
             ),
-            "sharding.gl8xx_findings": len(findings),
+            "sharding.gl8xx_findings": _family_findings("GL8"),
         }
     except Exception as exc:  # pragma: no cover - env-specific
         return {"sharding.advisory_error": f"{type(exc).__name__}: {exc}"}
+
+
+def surface_metrics() -> tuple[dict, dict]:
+    """GL9xx compile-surface rows (ISSUE 19): (gated, advisory).
+
+    Gated: the combo universe's total cardinality bound in log2 —
+    exact and jax-version-independent (see EXACT_METRICS). Advisory:
+    the committed universe's dimension count (a shrinking count means a
+    combo field silently left the extraction) and the live GL9xx
+    finding count over the tree gomelint's CI invocation sweeps — both
+    already FAIL CI through gomelint when they drift; the rows put the
+    trend in every perf log, same split as the GL8xx pair."""
+    try:
+        from gome_tpu.analysis.surface import DEFAULT_UNIVERSE, load_universe
+
+        committed = load_universe(os.path.join(ROOT, DEFAULT_UNIVERSE))
+        gated = {
+            "surface.combo_universe_log2": _universe_log2(),
+        }
+        advisory = {
+            "surface.universe_entries": (
+                len(committed["dimensions"]) if committed else 0
+            ),
+            "surface.gl9xx_findings": _family_findings("GL9"),
+        }
+        return gated, advisory
+    except Exception as exc:  # pragma: no cover - env-specific
+        return {}, {"surface.advisory_error": f"{type(exc).__name__}: {exc}"}
 
 
 def collect() -> dict:
@@ -432,6 +488,9 @@ def collect() -> dict:
     advisory.update(fleet_chaos_advisory())
     advisory.update(capacity_advisory())
     advisory.update(sharding_advisory())
+    surf_gated, surf_advisory = surface_metrics()
+    gated.update(surf_gated)
+    advisory.update(surf_advisory)
     return {
         "jax": jax.__version__,
         "gated": gated,
@@ -660,6 +719,15 @@ def main(argv: list[str] | None = None) -> int:
             "finding(s) in the tree — gomelint's analysis-job ratchet "
             "should be failing; fix or suppress with an owning "
             "workstream before trusting the sharding manifest"
+        )
+    gl9 = current["advisory"].get("surface.gl9xx_findings")
+    if gl9 is not None and gl9 > 0:
+        print(
+            f"# WARNING (advisory, non-gating): {gl9} live GL9xx "
+            "finding(s) in the tree — the compile-surface contract "
+            "(combo-key agreement / quantizer lattice / precompile "
+            "coverage) is violated and gomelint's analysis-job ratchet "
+            "should be failing; fix before trusting the combo universe"
         )
     if regressions:
         print(f"perf_ratchet: {len(regressions)} regressed metric(s):")
