@@ -207,7 +207,19 @@ class OpsConfig:
     second with a `hostprof_keep`-deep raw-stack ring, behind the
     /hostprof endpoint and the gome_hostprof_* gauges. The admit drill
     (the measured per-stage gateway breakdown) runs only on demand
-    (?drill=1), never on the serving path."""
+    (?drill=1), never on the serving path.
+
+    placement/placement_topk/placement_alpha/placement_partitions
+    configure the placement observatory (gome_tpu.obs.placement): with
+    placement on, the PLACEMENT singleton is armed at boot — the
+    gateway admit hooks feed a `placement_topk`-deep Space-Saving
+    heavy-hitter sketch, the dense-dispatch hook keeps the occupancy
+    ledger + per-lane EWMA rates (smoothing `placement_alpha`), and the
+    skew-attribution rows compute the what-if hash imbalance over
+    `placement_partitions` partitions — all behind the /placement
+    endpoint and the gome_placement_* gauges. A committed
+    PLACEMENT_r01.json verdict at the repo root rides the payload when
+    present."""
 
     host: str = "127.0.0.1"
     port: int = 9109
@@ -225,6 +237,10 @@ class OpsConfig:
     hostprof: bool = True  # arm the host-CPU sampling profiler
     hostprof_hz: float = 67.0  # live wall-sampler cadence (Hz)
     hostprof_keep: int = 4096  # raw-stack ring size (samples)
+    placement: bool = True  # arm the placement observatory
+    placement_topk: int = 64  # Space-Saving sketch capacity (symbols)
+    placement_alpha: float = 0.2  # per-lane EWMA smoothing factor
+    placement_partitions: int = 8  # what-if hash-imbalance partitions
 
     def __post_init__(self) -> None:
         if self.trace_keep <= 0:
@@ -263,6 +279,21 @@ class OpsConfig:
             raise ValueError(
                 f"ops.hostprof_keep must be positive, got "
                 f"{self.hostprof_keep}"
+            )
+        if self.placement_topk <= 0:
+            raise ValueError(
+                f"ops.placement_topk must be positive, got "
+                f"{self.placement_topk}"
+            )
+        if not (0.0 < self.placement_alpha <= 1.0):
+            raise ValueError(
+                f"ops.placement_alpha must be in (0, 1], got "
+                f"{self.placement_alpha}"
+            )
+        if self.placement_partitions <= 0:
+            raise ValueError(
+                f"ops.placement_partitions must be positive, got "
+                f"{self.placement_partitions}"
             )
 
 

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.placement import PLACEMENT
 from ..obs.profiler import PROFILER
 from ..types import KERNELS, Action, MatchResult, Order
 from ..utils.metrics import REGISTRY
@@ -969,6 +970,9 @@ class BatchEngine:
             lane_ids = np.full(n_rows, self.n_slots, np.int64)
             lane_ids[: len(live)] = live
             rows_for_live = np.arange(len(live), dtype=np.int64)
+            # Occupancy ledger (obs.placement): dispatched-vs-live rows
+            # for the unsharded dense grid, values already in hand.
+            PLACEMENT.note_dispatch(n_rows, live)
         else:
             d = self.mesh.size
             local = self.n_slots // d
@@ -994,6 +998,7 @@ class BatchEngine:
             # profiler's dispatch ring) from values already in hand.
             _dense_shard_skew.observe(int(counts.max()) * d / len(live))
             PROFILER.note_shard_dispatch(d, r_s, counts)
+            PLACEMENT.note_dispatch(n_rows, live, counts, r_s)
         row_of = np.empty(self.n_slots, np.int64)
         row_of[live] = rows_for_live
         # Skew telemetry: what row padding (pow2 bucket, grow-only floor,

@@ -399,6 +399,7 @@ class FleetAggregator:
             "seq": None,
             "durability": None,
             "timeline": (),
+            "placement": None,
         }
         try:
             hz = json.loads(self._fetch(url + "/healthz", self.timeout_s))
@@ -417,6 +418,15 @@ class FleetAggregator:
             state["timeline"] = list((tl or {}).get("samples", ()))[-8:]
         except Exception as exc:  # one dead member must not kill the poll
             state["error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            # Placement scrape rides its own try: a member predating the
+            # /placement surface (or running with it off) must not mark
+            # the whole member unhealthy — its health/metrics above stay.
+            state["placement"] = json.loads(
+                self._fetch(url + "/placement", self.timeout_s)
+            )
+        except Exception:
+            state["placement"] = None
         return state
 
     def start(self) -> "FleetAggregator":
@@ -542,6 +552,7 @@ class FleetAggregator:
         }
         return {
             "enabled": True,
+            "placement": self._placement_rollup(snap),
             "members": members_out,
             # Members whose latest scrape failed or went stale — callers
             # (and the fleet drill verdict) see explicitly WHOSE data is
@@ -553,6 +564,71 @@ class FleetAggregator:
             "seq": {"procs": seq_procs, "fleet": fleet_seq},
             "timeline": timeline,
         }
+
+    # -- placement flow rollup ---------------------------------------------
+    def _placement_rollup(self, snap: dict) -> dict | None:
+        """Fleet-wide symbol-flow view from the members' /placement
+        scrapes: per-member admitted-order share (the live form of
+        FLEET_r01's imbalance table — max over mean of member order
+        totals) and the merged heavy-hitter table (obs.placement.
+        SpaceSaving sketches fold losslessly, so the rollup is exact
+        whichever order members merge). None while no member reports an
+        armed observatory."""
+        from .placement import SpaceSaving
+
+        members: dict[str, dict] = {}
+        rollup = None
+        for name in sorted(snap):
+            pl = snap[name].get("placement")
+            if not (isinstance(pl, dict) and pl.get("enabled")):
+                continue
+            members[name] = {"admits": int(pl.get("admits", 0))}
+            blob = (pl.get("sketch") or {}).get("bytes_hex")
+            if not blob:
+                continue
+            try:
+                sk = SpaceSaving.from_bytes(bytes.fromhex(blob))
+            except ValueError:
+                members[name]["sketch_error"] = "undecodable"
+                continue
+            if rollup is None:
+                rollup = sk
+            else:
+                rollup.merge(sk)
+        if not members:
+            return None
+        total = sum(m["admits"] for m in members.values())
+        for m in members.values():
+            m["order_share"] = (
+                round(m["admits"] / total, 4) if total else 0.0
+            )
+        return {
+            "members": members,
+            "partition_imbalance_max_over_mean": self.partition_imbalance(),
+            "flow": None if rollup is None else {
+                "total": rollup.total,
+                "tracked": rollup.tracked,
+                "top": rollup.top(16),
+            },
+        }
+
+    def partition_imbalance(self) -> float:
+        """Live partition order imbalance: max over mean of per-member
+        admitted-order totals from the latest placement scrapes (1.0 =
+        perfectly even, FLEET_r01 measured 1.56 before the fix). 0.0
+        while fewer than one member reports an armed observatory."""
+        with self._lock:
+            snap = dict(self._last)
+        admits = [
+            int(st["placement"].get("admits", 0))
+            for st in snap.values()
+            if isinstance(st.get("placement"), dict)
+            and st["placement"].get("enabled")
+        ]
+        total = sum(admits)
+        if not admits or not total:
+            return 0.0
+        return max(admits) / (total / len(admits))
 
     # -- metrics export ----------------------------------------------------
     def _export(self, registry: Registry) -> None:
@@ -585,6 +661,12 @@ class FleetAggregator:
             "gome_fleet_fetch_errors_total",
             "member endpoint fetches that failed",
             lambda: self._fetch_errors,  # gomelint: disable=GL402
+        )
+        registry.callback_gauge(
+            "gome_fleet_partition_imbalance",
+            "max/mean of per-member admitted-order totals from the "
+            "latest placement scrapes (1.0 = even; 0 = no data)",
+            self.partition_imbalance,
         )
         # Per-member liveness: one labeled child per member name (the
         # member set is fixed at install time). 1 = latest scrape

@@ -329,6 +329,8 @@ def service_timeline(service, sampler: TimelineSampler | None = None):
                  the service's gateway runs one)
       persist  — snapshot cadence + recovery state (only when the service
                  runs a Persister)
+      placement — cumulative dispatch occupancy (dispatched/live/padding
+                 rows, obs.placement; {} while the observatory is off)
     """
     tl = sampler or TIMELINE
     engine = getattr(service, "engine", service)
@@ -427,4 +429,14 @@ def service_timeline(service, sampler: TimelineSampler | None = None):
         # Snapshot cadence + recovery state (persist.snapshot.Persister) —
         # soak verdicts can now see whether snapshots kept their cadence.
         tl.register("persist", persist.probe)
+
+    def placement_probe():
+        # Occupancy history (obs.placement): cumulative dispatched/live/
+        # padding rows per sample, so padding drift rides /timeline next
+        # to RSS and queue depth. {} while the observatory is disarmed.
+        from .placement import PLACEMENT
+
+        return PLACEMENT.occupancy_probe()
+
+    tl.register("placement", placement_probe)
     return tl

@@ -208,6 +208,28 @@ class EngineService:
                     hz=self.config.ops.hostprof_hz,
                     keep_n=self.config.ops.hostprof_keep,
                 )
+            if self.config.ops.placement:
+                # Arm the placement observatory (gome_tpu.obs.placement):
+                # gateway admit hooks feed the heavy-hitter symbol
+                # sketch, the dense-dispatch hook keeps the occupancy
+                # ledger, and the /placement endpoint serves the skew
+                # attribution + the committed what-if verdict when one
+                # is checked in next to the package.
+                import numpy as np
+
+                from ..engine.book import DeviceOp, GRID_I32_FIELDS
+                from ..obs import placement as _placement
+
+                itemsize = np.dtype(e.dtype).itemsize
+                n_i32 = len(GRID_I32_FIELDS)
+                n_val = len(DeviceOp._fields) - n_i32
+                _placement.PLACEMENT.install(
+                    topk=self.config.ops.placement_topk,
+                    ewma_alpha=self.config.ops.placement_alpha,
+                    row_bytes=(n_i32 * 4 + n_val * itemsize) * e.max_t,
+                    partitions=self.config.ops.placement_partitions,
+                    verdict=_placement.default_verdict(),
+                )
             if self.config.fleet.enabled:
                 # Arm the fleet aggregator (gome_tpu.obs.fleet): this
                 # process polls the listed members' ops endpoints and

@@ -30,6 +30,7 @@ from ..bus.colwire import encode_order_block, encode_order_frame_blocks
 from ..config import Config
 from ..fixed import scale
 from ..obs.hostprof import HOSTPROF
+from ..obs.placement import PLACEMENT
 from ..types import Action, Order, OrderType, Side
 from ..utils.faults import FAULTS
 from ..utils.logging import get_logger
@@ -304,6 +305,7 @@ class OrderGateway:
             )
         # main.go:49: unconditional success; matching outcome arrives async.
         HOSTPROF.note_admit()  # disabled: one attribute check, no allocs
+        PLACEMENT.note_admit(order.symbol)  # same disabled contract
         return pb.OrderResponse(code=0, message="order accepted")
 
     def DeleteOrder(self, request: pb.OrderRequest, context) -> pb.OrderResponse:
@@ -333,6 +335,7 @@ class OrderGateway:
                 code=CODE_REJECT, message=f"rejected: {e}"
             )
         HOSTPROF.note_admit()
+        PLACEMENT.note_admit(order.symbol)  # cancels are symbol flow too
         return pb.OrderResponse(code=0, message="cancel accepted")
 
     def _apply_entries(self, entries) -> pb.OrderBatchResponse:
@@ -381,6 +384,7 @@ class OrderGateway:
                 resp.message = f"batch aborted at entry {i}: {e}"
                 break
             accepted += 1
+            PLACEMENT.note_admit(order.symbol)  # disabled: one attr check
         resp.accepted = accepted
         if accepted:
             HOSTPROF.note_admit(accepted)  # one locked add per batch
@@ -566,6 +570,9 @@ class OrderGateway:
             resp.message = f"batch aborted at entry {first}: {e}"
             return 0
         HOSTPROF.note_admit(m)  # one locked add per block
+        # Symbol-flow sketch (obs.placement): the armed hook bincounts
+        # the already-interned columns; disabled it is one attr check.
+        PLACEMENT.note_admit_frame(cols["symbols"], cols["symbol_idx"])
         return m
 
     def DoOrderBatch(

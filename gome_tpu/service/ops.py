@@ -30,6 +30,10 @@ one stdlib ThreadingHTTPServer, no dependencies, curl-able:
                                     # (obs.capacity): offered-rate ladder,
                                     # knee, corrected percentiles,
                                     # bottleneck attribution
+    curl localhost:9109/placement   # symbol-flow heavy hitters, lane/shard
+                                    # occupancy ledger, skew attribution,
+                                    # and the committed what-if placement
+                                    # verdict (obs.placement)
 
 Enabled by an `ops:` section in config.yaml (port, host) or by
 constructing OpsServer directly around any EngineService.
@@ -204,6 +208,18 @@ class OpsServer:
 
         return CAPACITY.payload()
 
+    def placement_payload(self) -> dict:
+        """The /placement JSON document: the placement observatory
+        (gome_tpu.obs.placement.PLACEMENT) — the heavy-hitter symbol
+        table + mergeable sketch bytes, the dispatch occupancy ledger
+        (rows, padding, per-shard blocks), the hot-lane EWMA table, the
+        skew attribution rows against the committed baselines, and the
+        installed what-if placement verdict (scripts/placement_eval.py).
+        ``{"enabled": false}`` while disarmed."""
+        from ..obs.placement import PLACEMENT
+
+        return PLACEMENT.payload()
+
     def hostprof_payload(self, run_drill: bool = False) -> dict:
         """The /hostprof JSON document: the host-CPU sampling profiler
         (gome_tpu.obs.hostprof.HOSTPROF) — the live wall-profile stage
@@ -304,6 +320,11 @@ class OpsServer:
                             ops.capacity_payload(), default=str
                         ).encode()
                         self._send(200, body, "application/json")
+                    elif self.path.split("?")[0] == "/placement":
+                        body = json.dumps(
+                            ops.placement_payload(), default=str
+                        ).encode()
+                        self._send(200, body, "application/json")
                     elif self.path.split("?")[0] == "/trace":
                         query = (self.path.split("?", 1)[1:] or [""])[0]
                         rec = ops.tracer.recorder
@@ -342,7 +363,7 @@ class OpsServer:
         self._thread.start()
         log.info("ops endpoint up on %s:%d (/metrics, /healthz, /trace, "
                  "/cost, /timeline, /profile, /hostprof, /durability, "
-                 "/fleet, /capacity)",
+                 "/fleet, /capacity, /placement)",
                  self.host, self.port)
         return self
 

@@ -43,6 +43,14 @@ in-process service stack and dump the operator surfaces to files —
                           the bottleneck-attribution table, asserted
                           at capture time to have non-empty rows that
                           sum back to e2e latency at every point
+  <out_dir>/placement.json  the /placement payload (ISSUE 20): the
+                          heavy-hitter symbol-flow sketch fed by the
+                          drill's own admits, the occupancy ledger from
+                          its dispatches, and the skew attribution —
+                          asserted at capture time to have a non-empty
+                          top table and a reconciled attribution
+  <out_dir>/PLACEMENT_r01.json  copy of the committed what-if placement
+                          verdict so the CI artifact bundle carries it
 
     python scripts/obs_snapshot.py [out_dir=obs-artifacts]
 
@@ -213,6 +221,41 @@ def main(out_dir: str = "obs-artifacts") -> int:
     if rep.get("perfetto_trace") and os.path.exists(rep["perfetto_trace"]):
         perfetto_out = os.path.join(out_dir, "perfetto_trace.json.gz")
         shutil.copyfile(rep["perfetto_trace"], perfetto_out)
+
+    # The /placement payload (ISSUE 20): ops.placement armed the
+    # observatory at boot, so the scripted traffic above already fed
+    # it — the 8 DoOrders + 1 Delete went through the gateway admit
+    # hook into the symbol sketch, and pump()'s dense dispatch fed the
+    # occupancy ledger. Captured HERE, before the hostprof admit drills
+    # below push their own synthetic flow through the same gateway
+    # hook and drown the scripted symbol. Assert the surface is real:
+    # a non-empty heavy-hitter table topped by the drill's one symbol,
+    # and an attribution whose components reconcile against the
+    # observed rows-per-live-lane.
+    from gome_tpu.obs.placement import PLACEMENT
+
+    placement_doc = ops.placement_payload()
+    assert placement_doc["enabled"], "ops.placement did not arm"
+    pl_top = placement_doc["top"]
+    assert pl_top and pl_top[0]["symbol"] == "eth2usdt", (
+        f"heavy-hitter table missed the drill flow: {pl_top}"
+    )
+    pl_attr = placement_doc["attribution"]
+    assert pl_attr["reconciliation"]["within_tol"], (
+        f"placement attribution does not reconcile: {pl_attr}"
+    )
+    assert "gome_placement_admits_total" in REGISTRY.render(), (
+        "placement gauges missing"
+    )
+    with open(os.path.join(out_dir, "placement.json"), "w") as f:
+        json.dump(placement_doc, f, indent=1, default=str)
+    # The committed what-if verdict rides along in the CI upload, same
+    # as HOSTPROF_r02 below: every push's bundle carries the current
+    # PLACEMENT_r01 policy table next to the live-measured sketch.
+    r01 = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PLACEMENT_r01.json")
+    if os.path.exists(r01):
+        shutil.copyfile(r01, os.path.join(out_dir, "PLACEMENT_r01.json"))
     # The /hostprof payload (ops.hostprof armed HOSTPROF at boot): the
     # service is not start()ed here so the live wall sampler never ran —
     # the admit drill (run_drill, same as ?drill=1) supplies the
@@ -405,12 +448,16 @@ def main(out_dir: str = "obs-artifacts") -> int:
         f"points, knee "
         + (f"at {cap_knee['offered_per_sec']:.0f}/s offered"
            if cap_knee.get("found") else "not reached")
-        + f", saturated stage: {cap_knee.get('saturated_stage')})"
+        + f", saturated stage: {cap_knee.get('saturated_stage')}), "
+        f"{out_dir}/placement.json ({placement_doc['admits']} admits "
+        f"sketched, top symbol {pl_top[0]['symbol']} at "
+        f"{pl_top[0]['share']:.0%} share)"
     )
     JOURNAL.disable()
     TIMELINE.disable()
     PROFILER.disable()
     HOSTPROF.disable()
+    PLACEMENT.disable()
     return 0
 
 

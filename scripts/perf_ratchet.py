@@ -391,6 +391,37 @@ def capacity_advisory() -> dict:
         return {"capacity.advisory_error": f"{type(exc).__name__}: {exc}"}
 
 
+def placement_advisory() -> dict:
+    """Placement-observatory surface (ISSUE 20), ADVISORY only — never
+    gated: the verdict is a host-side what-if prediction, and gating a
+    prediction would ratchet the model instead of the engine.
+
+    Sourced from the committed placement verdict (PLACEMENT_r01.json at
+    the repo root, regenerated by ``scripts/placement_eval.py --out``):
+    how concentrated the committed Zipf flow is (top-16 symbol share),
+    the observed dense shard skew the replay reconciled against
+    MULTICHIP_r06, and the best candidate policy's predicted skew — the
+    gap between those last two is the placement headroom ROADMAP open
+    item 2 leaves on the table, trended in every CI log."""
+    try:
+        from gome_tpu.obs.placement import load_verdict
+
+        verdict = load_verdict(os.path.join(ROOT, "PLACEMENT_r01.json"))
+        return {
+            "placement.top16_share": verdict["workload"]["top16_share"],
+            "placement.observed_shard_skew": (
+                verdict["attribution"]["observed"]["shard_skew"]
+            ),
+            "placement.predicted_best_skew": (
+                verdict["winner"]["predicted_shard_skew"]
+            ),
+            "placement.best_policy": verdict["winner"]["policy"],
+            "placement.verdict_pass": bool(verdict["checks"]["pass"]),
+        }
+    except Exception as exc:  # pragma: no cover - env-specific
+        return {"placement.advisory_error": f"{type(exc).__name__}: {exc}"}
+
+
 #: The gomelint sweeps and the universe extraction below read the SOURCE
 #: TREE, which is immutable for the life of a ratchet process — but the
 #: in-process test harness calls collect() several times per process,
@@ -487,6 +518,7 @@ def collect() -> dict:
     advisory.update(fleet_advisory())
     advisory.update(fleet_chaos_advisory())
     advisory.update(capacity_advisory())
+    advisory.update(placement_advisory())
     advisory.update(sharding_advisory())
     surf_gated, surf_advisory = surface_metrics()
     gated.update(surf_gated)
@@ -711,6 +743,34 @@ def main(argv: list[str] | None = None) -> int:
             "# WARNING (advisory, non-gating): the committed capacity "
             "verdict has pass=false — tests/test_capacity.py should be "
             "failing; investigate before trusting capacity numbers"
+        )
+    obs_skew = current["advisory"].get("placement.observed_shard_skew")
+    best_skew = current["advisory"].get("placement.predicted_best_skew")
+    if obs_skew is not None and best_skew:
+        print(
+            f"# ADVISORY (never gated, model-predicted): committed Zipf "
+            f"flow top-16 share "
+            f"{current['advisory'].get('placement.top16_share')}, "
+            f"observed D=8 shard skew {obs_skew} vs predicted-best "
+            f"{best_skew} under "
+            f"{current['advisory'].get('placement.best_policy')} "
+            "(PLACEMENT_r01.json; regenerate with "
+            "scripts/placement_eval.py --out PLACEMENT_r01.json)"
+        )
+        if obs_skew / best_skew > 1.5:
+            print(
+                f"# WARNING (advisory, non-gating): observed shard skew "
+                f"{obs_skew} is {obs_skew / best_skew:.2f}x the "
+                f"predicted-best {best_skew} — the what-if evaluator "
+                "says a committed policy would beat today's block "
+                "placement by >1.5x; ROADMAP open item 2 is leaving "
+                "real rows on the table"
+            )
+    if current["advisory"].get("placement.verdict_pass") is False:
+        print(
+            "# WARNING (advisory, non-gating): the committed placement "
+            "verdict has pass=false — tests/test_placement.py should "
+            "be failing; investigate before trusting placement numbers"
         )
     gl8 = current["advisory"].get("sharding.gl8xx_findings")
     if gl8 is not None and gl8 > 0:

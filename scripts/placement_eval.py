@@ -1,0 +1,331 @@
+#!/usr/bin/env python
+"""What-if placement evaluator (ISSUE 20): score candidate symbol->shard
+policies against the committed Zipf workload, host-side only.
+
+ROADMAP items 1 and 2 share one disease — naive placement. The committed
+measurements say what it costs today (``MULTICHIP_r06.json``: D=8 dense
+shard skew 3.64, every shard padded to the hottest shard's row block;
+``FLEET_r01.json``: 1.56x partition order imbalance) but nothing says
+which policy would fix it. This evaluator replays the EXACT symbol-flow
+profile MULTICHIP_r06 measured — ``np.random.default_rng(17)``,
+``zipf(1.2, S // 4) % S`` over S=4096 symbols, the seeded Zipf/Hawkes
+flow family of the deterministic simulator (gome_tpu.sim.flow,
+arXiv:2510.08085; placement scoring consumes per-symbol arrival totals,
+which the Zipf draw fixes) — against candidate placement policies and
+predicts, per policy:
+
+  * partition_imbalance_max_over_mean — per-partition order flow skew
+    (the FLEET_r01 axis)
+  * shard_skew — max per-shard live-lane count x D / live (the
+    MULTICHIP_r06 axis)
+  * rows_per_live_lane — the dense packer's real cost under its
+    uniform-R_s pow2 row bucketing (engine.batch._grid_geometry)
+  * padding_bytes_per_order — wasted op-grid bytes at the committed
+    geometry (t=16, int32 cells)
+  * symbols_moved_vs_current — migration cost vs today's layout
+
+Everything is pure host-side arithmetic over the recorded flow — no
+serving-path change, no device — and fully deterministic: running twice
+produces byte-identical verdicts (tests/test_placement.py pins this and
+the committed artifact). The verdict (schema
+``gome-placement-verdict-v1``) carries the policy x metric table, the
+skew-attribution rows reconciled against the committed observation, and
+a named winner — the before/after contract ROADMAP item 2's fix must
+honor.
+
+Policies:
+
+  current_block     today's engine layout: interner-ordered lanes in
+                    contiguous per-shard blocks (lane // (S/D)) — must
+                    reproduce MULTICHIP_r06's measured skew exactly,
+                    which anchors the replay to the committed artifact.
+  fnv1a_mod         the fleet's partition policy applied to lanes
+                    (gome_tpu.fleet.router.partition_of — the one
+                    blessed symbol hash tree-wide).
+  consistent_hash   a 64-vnode-per-shard hash ring over the symbol
+                    interner's names (fnv1a points, bisect lookup) —
+                    minimal movement under resize, same long-run balance
+                    class as fnv1a_mod.
+  greedy_lpt        longest-processing-time flow balancing: symbols in
+                    descending flow order, each to the least-flow-loaded
+                    shard (ties: fewest lanes, lowest shard id). Needs
+                    the measured flow profile — which is exactly what
+                    the placement observatory's sketch records live.
+
+Usage:
+    python scripts/placement_eval.py                     # print verdict
+    python scripts/placement_eval.py --out PLACEMENT_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gome_tpu.fleet.router import partition_of
+from gome_tpu.obs.placement import SCHEMA, shard_skew_baseline
+from gome_tpu.parallel.router import fnv1a
+
+# The committed MULTICHIP_r06 workload + geometry, pinned (see
+# scripts/mesh_overhead.py curve()): one fixed Zipf(1.2) live set over
+# 4096 symbols, dispatched dense at D=8, t=16, int32 books.
+SEED = 17
+SYMBOLS = 4096
+ZIPF_A = 1.2
+DEVICES = 8
+T = 16
+CAP = 64
+#: int32 op-grid cell: 3 x int32 index fields + 4 x int32 value fields
+#: (obs.compile_journal.frame_combo_detail's ops_grid_bytes).
+CELL_BYTES = 3 * 4 + 4 * 4
+VNODES = 64
+WINNER_SKEW_BUDGET = 1.3
+RECONCILE_TOL = 0.05
+
+
+def workload():
+    """The committed flow profile: per-symbol arrival counts + live set.
+
+    Identical draw to MULTICHIP_r06 (rng 17, zipf(1.2, S//4) % S), so
+    the replay's ``current_block`` point must land on the committed
+    measurement exactly."""
+    rng = np.random.default_rng(SEED)
+    draws = rng.zipf(ZIPF_A, size=SYMBOLS // 4) % SYMBOLS
+    flow = np.bincount(draws, minlength=SYMBOLS)
+    live = np.flatnonzero(flow)
+    names = [f"SYM{i:04d}" for i in live]
+    return flow[live].astype(np.int64), live.astype(np.int64), names
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+# -- candidate policies: live-symbol array -> shard id array --------------
+
+
+def policy_current_block(live: np.ndarray, names, flow) -> np.ndarray:
+    """Today's layout: interner order, contiguous per-shard lane blocks
+    (engine.batch._grid_geometry: shard = lane // (n_slots / D))."""
+    return live // (SYMBOLS // DEVICES)
+
+
+def policy_fnv1a_mod(live, names, flow) -> np.ndarray:
+    """The blessed fleet hash applied at lane granularity."""
+    return np.array(
+        [partition_of(n, DEVICES) for n in names], np.int64
+    )
+
+
+def policy_consistent_hash(live, names, flow) -> np.ndarray:
+    """Hash ring over the symbol interner's names: VNODES points per
+    shard, symbol owned by the first ring point at or clockwise of its
+    own hash (wrapping). No modulo on the hash — ownership comes from
+    the ring, so resizing moves only the symbols between new points."""
+    points = sorted(
+        (fnv1a(f"shard{p}/vnode{v}"), p)
+        for p in range(DEVICES)
+        for v in range(VNODES)
+    )
+    keys = [pt[0] for pt in points]
+    owners = [pt[1] for pt in points]
+    out = np.empty(len(names), np.int64)
+    for i, n in enumerate(names):
+        j = bisect.bisect_left(keys, fnv1a(n))
+        out[i] = owners[j if j < len(owners) else 0]
+    return out
+
+
+def policy_greedy_lpt(live, names, flow) -> np.ndarray:
+    """Greedy LPT flow balancing: heaviest symbol first, each to the
+    shard with the least assigned flow (ties: fewest lanes, lowest
+    id) — the classic 4/3-approximate makespan heuristic, applied to
+    order flow. Deterministic: ties in flow break on symbol id."""
+    order = sorted(range(len(live)), key=lambda i: (-int(flow[i]), int(live[i])))
+    loads = [0] * DEVICES
+    lanes = [0] * DEVICES
+    out = np.empty(len(live), np.int64)
+    for i in order:
+        g = min(range(DEVICES), key=lambda d: (loads[d], lanes[d], d))
+        out[i] = g
+        loads[g] += int(flow[i])
+        lanes[g] += 1
+    return out
+
+
+POLICIES = (
+    ("current_block", policy_current_block),
+    ("fnv1a_mod", policy_fnv1a_mod),
+    ("consistent_hash", policy_consistent_hash),
+    ("greedy_lpt", policy_greedy_lpt),
+)
+
+
+def score(groups: np.ndarray, flow: np.ndarray,
+          current: np.ndarray) -> dict:
+    """Predicted cost of one placement under the engine's real dense
+    geometry: uniform per-shard row block R_s = pow2(max live count,
+    min 8), every shard dispatching R_s rows (_grid_geometry)."""
+    counts = np.bincount(groups, minlength=DEVICES)
+    flows = np.bincount(groups, weights=flow, minlength=DEVICES)
+    n_live = int(len(groups))
+    orders = int(flow.sum())
+    mx = int(counts.max())
+    r_s = max(8, _next_pow2(mx))
+    rows = r_s * DEVICES
+    return {
+        "partition_imbalance_max_over_mean": round(
+            float(flows.max()) / (orders / DEVICES), 4
+        ),
+        "shard_skew": round(mx * DEVICES / n_live, 4),
+        "r_s": r_s,
+        "dispatched_rows": rows,
+        "rows_per_live_lane": round(rows / n_live, 4),
+        "padding_bytes_per_order": round(
+            (rows - n_live) * T * CELL_BYTES / orders, 2
+        ),
+        "symbols_moved_vs_current": round(
+            float((groups != current).mean()), 4
+        ),
+        "live_per_shard": [int(c) for c in counts],
+    }
+
+
+def build_verdict() -> dict:
+    """The full deterministic verdict document (no clocks, no host
+    state — same inputs, same bytes)."""
+    flow, live, names = workload()
+    orders = int(flow.sum())
+    n_live = int(len(live))
+    top16 = np.sort(flow)[::-1][:16]
+
+    current = policy_current_block(live, names, flow)
+    table = []
+    for name, fn in POLICIES:
+        row = {"policy": name}
+        row.update(score(fn(live, names, flow), flow, current))
+        table.append(row)
+
+    # Attribution: decompose the CURRENT policy's predicted cost and
+    # reconcile it against the committed MULTICHIP_r06 measurement —
+    # the replay is only trustworthy if it reproduces the committed
+    # observation it claims to explain.
+    cur = table[0]
+    skew = cur["shard_skew"]
+    padding = cur["r_s"] / max(cur["live_per_shard"])
+    product = skew * padding
+    baseline = shard_skew_baseline() or {}
+    observed = baseline.get("rows_per_live_lane") or cur["rows_per_live_lane"]
+    frac = abs(product - observed) / observed
+    skew_frac = (
+        abs(skew - baseline["shard_skew"]) / baseline["shard_skew"]
+        if baseline.get("shard_skew") else 0.0
+    )
+    attribution = {
+        "observed": {
+            "artifact": baseline.get("artifact"),
+            "rows_per_live_lane": observed,
+            "shard_skew": baseline.get("shard_skew"),
+        },
+        "components": [
+            {"component": "lane_placement_skew", "value": round(skew, 4)},
+            {"component": "cap_class_padding", "value": round(padding, 4)},
+        ],
+        "reconciliation": {
+            "product": round(product, 4),
+            "frac_err": round(frac, 6),
+            "within_tol": frac <= RECONCILE_TOL,
+            "replayed_skew_frac_err": round(skew_frac, 6),
+            "tol": RECONCILE_TOL,
+        },
+    }
+
+    winner = min(
+        table,
+        key=lambda r: (
+            r["rows_per_live_lane"],
+            r["partition_imbalance_max_over_mean"],
+            r["policy"],
+        ),
+    )
+    checks = {
+        "attribution_reconciles": attribution["reconciliation"]["within_tol"],
+        "replay_matches_committed_skew": skew_frac <= RECONCILE_TOL,
+        "winner_shard_skew_le": WINNER_SKEW_BUDGET,
+        "winner_within_budget": winner["shard_skew"] <= WINNER_SKEW_BUDGET,
+    }
+    checks["pass"] = all(
+        v for k, v in checks.items() if isinstance(v, bool)
+    )
+    return {
+        "schema": SCHEMA,
+        "artifact": "PLACEMENT_r01",
+        "method": (
+            "host-side what-if replay of the committed MULTICHIP_r06 "
+            f"Zipf({ZIPF_A}) flow (rng {SEED}, zipf(a, S//4) % S, "
+            f"S={SYMBOLS}) against {len(POLICIES)} placement policies; "
+            "each scored under the engine's real dense geometry "
+            "(uniform R_s = pow2(max per-shard live), "
+            "engine.batch._grid_geometry) at the committed t=16/int32 "
+            "cell cost. Deterministic: no clocks, no device."
+        ),
+        "workload": {
+            "seed": SEED,
+            "symbols": SYMBOLS,
+            "zipf_a": ZIPF_A,
+            "orders": orders,
+            "live_lanes": n_live,
+            "devices": DEVICES,
+            "t": T,
+            "cap": CAP,
+            "cell_bytes": CELL_BYTES,
+            "top16_share": round(float(top16.sum()) / orders, 4),
+        },
+        "attribution": attribution,
+        "policies": table,
+        "winner": {
+            "policy": winner["policy"],
+            "predicted_shard_skew": winner["shard_skew"],
+            "predicted_rows_per_live_lane": winner["rows_per_live_lane"],
+            "rule": (
+                "min rows_per_live_lane, then "
+                "partition_imbalance_max_over_mean, then policy name"
+            ),
+        },
+        "checks": checks,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default=None,
+                    help="write the verdict JSON here (default: stdout)")
+    args = ap.parse_args(argv)
+    doc = build_verdict()
+    text = json.dumps(doc, indent=1) + "\n"
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(
+            f"wrote {args.out}: winner={doc['winner']['policy']} "
+            f"(skew {doc['winner']['predicted_shard_skew']}), "
+            f"pass={doc['checks']['pass']}"
+        )
+    else:
+        print(text, end="")
+    return 0 if doc["checks"]["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
